@@ -1,0 +1,38 @@
+# Build system for the TPU-native P2P benchmark framework.
+#
+# The reference Makefile (/root/reference/Makefile:1-5) has one rule —
+# `nvcc -lmpi -lnccl p2p_matrix.cc -o p2p_matrix` — and a broken
+# `clean` (removes the wrong filename, Makefile:5). Per SURVEY.md L0,
+# the TPU build needs no GPU toolchain: `device=tpu` is a Python entry
+# point over jax[tpu]; the only native artifact is the host-side
+# support library (timing/hashing/stats — native/tpu_p2p_native.cc).
+
+CXX      ?= g++
+CXXFLAGS ?= -O2 -fPIC -std=c++17 -Wall -Wextra
+PYTHON   ?= python
+
+NATIVE_SO := native/libtpu_p2p_native.so
+
+.PHONY: all native run test bench clean
+
+all: native
+
+native: $(NATIVE_SO)
+
+$(NATIVE_SO): native/tpu_p2p_native.cc
+	$(CXX) $(CXXFLAGS) -shared $< -o $@
+
+# `make run device=tpu` — the TPU driver (the reference's
+# `mpirun -n N p2p_matrix`, README.md:5, becomes a plain Python entry:
+# JAX enumerates the slice's devices itself). Extra flags via ARGS=.
+run: native
+	$(PYTHON) -m tpu_p2p $(ARGS)
+
+test:
+	$(PYTHON) -m pytest tests/ -x -q
+
+bench: native
+	$(PYTHON) bench.py
+
+clean:
+	rm -f $(NATIVE_SO)

@@ -1,0 +1,103 @@
+// Native support library for the tpu_p2p framework.
+//
+// The reference (AmadeusChan/test-nccl-p2p) is a single natively
+// compiled C++ translation unit (/root/reference/p2p_matrix.cc, built
+// by /root/reference/Makefile:2). On TPU the data plane is XLA itself,
+// so the native surface that remains native here is the host-side
+// runtime support:
+//
+//  - tpu_p2p_monotonic_ns: step-free CLOCK_MONOTONIC timestamps,
+//    replacing the reference's std::chrono::system_clock reads
+//    (p2p_matrix.cc:153,174) which an NTP step could skew.
+//  - tpu_p2p_djb2a / tpu_p2p_host_hash: bit-parity with getHostHash /
+//    getHostName (p2p_matrix.cc:44-61) — DJB2a over the hostname
+//    truncated at the first '.'.
+//  - tpu_p2p_percentile / tpu_p2p_stats: sorting-based nearest-rank
+//    percentiles and one-pass stats over per-iteration samples (the
+//    reference keeps only a mean, p2p_matrix.cc:176; BASELINE.json's
+//    p50 metric needs more).
+//
+// Exposed via a C ABI for ctypes (pybind11 is unavailable in this
+// image). Build: `make native` → native/libtpu_p2p_native.so.
+
+#include <algorithm>
+#include <cmath>
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <vector>
+
+#include <unistd.h>
+
+extern "C" {
+
+// Monotonic nanoseconds. CLOCK_MONOTONIC is immune to wall-clock
+// steps, unlike the reference's system_clock (SURVEY.md §5 tracing).
+uint64_t tpu_p2p_monotonic_ns(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
+         static_cast<uint64_t>(ts.tv_nsec);
+}
+
+// DJB2a: h = h*33 ^ c, seed 5381 — parity with p2p_matrix.cc:44-51.
+uint64_t tpu_p2p_djb2a(const char* s) {
+  uint64_t result = 5381;
+  for (int c = 0; s[c] != '\0'; ++c) {
+    result = ((result << 5) + result) ^ static_cast<unsigned char>(s[c]);
+  }
+  return result;
+}
+
+// Hostname truncated at the first '.' (p2p_matrix.cc:53-61), hashed.
+uint64_t tpu_p2p_host_hash(void) {
+  char hostname[1024];
+  hostname[0] = '\0';
+  gethostname(hostname, sizeof(hostname));
+  hostname[sizeof(hostname) - 1] = '\0';
+  for (size_t i = 0; i < sizeof(hostname) && hostname[i] != '\0'; ++i) {
+    if (hostname[i] == '.') {
+      hostname[i] = '\0';
+      break;
+    }
+  }
+  return tpu_p2p_djb2a(hostname);
+}
+
+// Nearest-rank percentile, matching timing.Samples.percentile:
+// rank = clamp(ceil(q/100 * n) - 1, 0, n-1) over ascending samples.
+double tpu_p2p_percentile(const double* samples, size_t n, double q) {
+  if (n == 0) return NAN;
+  std::vector<double> s(samples, samples + n);
+  std::sort(s.begin(), s.end());
+  long rank = static_cast<long>(std::ceil(q / 100.0 * static_cast<double>(n))) - 1;
+  if (rank < 0) rank = 0;
+  if (rank >= static_cast<long>(n)) rank = static_cast<long>(n) - 1;
+  return s[static_cast<size_t>(rank)];
+}
+
+// One pass: out = {mean, min, max, p50, p99}.
+void tpu_p2p_stats(const double* samples, size_t n, double* out) {
+  if (n == 0) {
+    for (int i = 0; i < 5; ++i) out[i] = NAN;
+    return;
+  }
+  std::vector<double> s(samples, samples + n);
+  std::sort(s.begin(), s.end());
+  double sum = 0.0;
+  for (double v : s) sum += v;
+  auto nearest_rank = [&](double q) {
+    long rank = static_cast<long>(std::ceil(q / 100.0 * static_cast<double>(n))) - 1;
+    if (rank < 0) rank = 0;
+    if (rank >= static_cast<long>(n)) rank = static_cast<long>(n) - 1;
+    return s[static_cast<size_t>(rank)];
+  };
+  out[0] = sum / static_cast<double>(n);
+  out[1] = s.front();
+  out[2] = s.back();
+  out[3] = nearest_rank(50.0);
+  out[4] = nearest_rank(99.0);
+}
+
+}  // extern "C"

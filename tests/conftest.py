@@ -1,0 +1,44 @@
+"""Test bootstrap: 8 simulated CPU devices, per SURVEY.md §4.
+
+The reference has no tests at all (its only correctness machinery is
+fail-fast macros — SURVEY.md §4); the idiomatic JAX strategy is to run
+everything on fake CPU devices via
+``--xla_force_host_platform_device_count`` so edge-set logic, payload
+verification, Gbps math, and report formatting are testable without
+TPU hardware.
+
+Note: this environment's sitecustomize imports jax (binding the TPU
+plugin) before pytest starts, so the platform switch happens via
+``jax.config.update`` rather than env vars — it must run before any
+backend is instantiated, hence here at conftest import time.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rt():
+    """A validated 8-device runtime on the simulated CPU mesh."""
+    from tpu_p2p.parallel.runtime import make_runtime
+
+    r = make_runtime()
+    assert r.num_devices == 8, "tests expect 8 simulated devices"
+    return r
+
+
+@pytest.fixture(scope="session")
+def rt2d():
+    """A 4x2 two-axis mesh for torus workload tests."""
+    from tpu_p2p.parallel.runtime import make_runtime
+
+    return make_runtime(mesh_shape=(4, 2), axis_names=("x", "y"))

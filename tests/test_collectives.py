@@ -1,0 +1,154 @@
+"""L4 collective-backend tests: edge sets, payload verification, a2a.
+
+Deterministic-payload correctness tests the reference lacks entirely
+(its buffers are zeroed and never checked — p2p_matrix.cc:129-130;
+SURVEY.md §4 item 2)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_p2p.parallel import collectives as C
+
+
+@pytest.fixture(scope="module")
+def cache():
+    return C.CollectiveCache()
+
+
+def _host(x):
+    return np.asarray(x)
+
+
+def test_payload_rank_tagged(rt):
+    x = C.make_payload(rt.mesh, 64, jnp.int8)
+    h = _host(x)
+    assert h.shape == (8, 64)
+    # Row r is (r*131 + iota) % 256 viewed as int8 — all rows distinct.
+    assert len({row.tobytes() for row in h}) == 8
+    expect0 = (np.arange(64) % 256).astype(np.uint8).view(np.int8)
+    np.testing.assert_array_equal(h[0], expect0)
+
+
+def test_unidir_edge_is_send_recv(rt, cache):
+    # [(src,dst)]: dst gets src's row, everyone else zeros —
+    # the ncclSend/ncclRecv pair of p2p_matrix.cc:156-171.
+    x = C.make_payload(rt.mesh, 128, jnp.int8)
+    fn = cache.permute(rt.mesh, "d", C.unidir_edges(2, 5))
+    y = _host(fn(x))
+    h = _host(x)
+    np.testing.assert_array_equal(y, C.expected_permute(h, [(2, 5)]))
+    np.testing.assert_array_equal(y[5], h[2])
+    assert not y[0].any() and not y[2].any()
+
+
+def test_bidir_edges_full_duplex(rt, cache):
+    # [(a,b),(b,a)] in ONE collective — the ncclGroupStart/End fusion
+    # of p2p_matrix.cc:211-251.
+    x = C.make_payload(rt.mesh, 128, jnp.int8)
+    fn = cache.permute(rt.mesh, "d", C.bidir_edges(1, 6))
+    y = _host(fn(x))
+    h = _host(x)
+    np.testing.assert_array_equal(y[6], h[1])
+    np.testing.assert_array_equal(y[1], h[6])
+    assert not y[3].any()
+
+
+def test_ring_edges_shift(rt, cache):
+    x = C.make_payload(rt.mesh, 256, jnp.int8)
+    fn = cache.permute(rt.mesh, "d", C.ring_edges(8))
+    y = _host(fn(x))
+    h = _host(x)
+    for i in range(8):
+        np.testing.assert_array_equal(y[(i + 1) % 8], h[i])
+
+
+def test_chain_applies_permutation_count_times(rt, cache):
+    x = C.make_payload(rt.mesh, 64, jnp.int8)
+    h = _host(x)
+    fn = cache.permute_chain(rt.mesh, "d", C.ring_edges(8), count=3)
+    y = _host(fn(x))
+    expect = h
+    for _ in range(3):
+        expect = C.expected_permute(expect, C.ring_edges(8))
+    np.testing.assert_array_equal(y, expect)
+    # shift-by-3 ring: row (i+3)%8 holds original row i
+    np.testing.assert_array_equal(y[3], h[0])
+
+
+def test_chain_unidir_decays_to_zero(rt, cache):
+    # Single-edge chains: after hop 1 the source's own row has no
+    # incoming edge, so hop 2 delivers zeros. Bandwidth is unaffected
+    # (the transfer still moves msg_size bytes); documented semantics.
+    x = C.make_payload(rt.mesh, 64, jnp.int8)
+    fn = cache.permute_chain(rt.mesh, "d", C.unidir_edges(0, 1), count=2)
+    y = _host(fn(x))
+    assert not y.any()
+
+
+def test_all_to_all_exchange(rt, cache):
+    n = 8
+    x = C.make_payload(rt.mesh, n * 16, jnp.int8)
+    fn = cache.all_to_all(rt.mesh, "d")
+    y = _host(fn(x))
+    np.testing.assert_array_equal(y, C.expected_all_to_all(_host(x), n))
+
+
+def test_cache_reuses_compiled_fns(rt):
+    cache = C.CollectiveCache()
+    f1 = cache.permute(rt.mesh, "d", [(0, 1)])
+    f2 = cache.permute(rt.mesh, "d", [(0, 1)])
+    f3 = cache.permute(rt.mesh, "d", [(0, 2)])
+    assert f1 is f2 and f1 is not f3
+    assert len(cache) == 2
+
+
+def test_duplicate_destination_rejected(rt, cache):
+    with pytest.raises(ValueError, match="duplicate destination"):
+        cache.permute(rt.mesh, "d", [(0, 3), (1, 3)])
+
+
+def test_elems_for_dtype_sizes():
+    assert C.elems_for(1024, np.int8) == 1024
+    assert C.elems_for(1024, np.float32) == 256
+    with pytest.raises(ValueError):
+        C.elems_for(3, np.float32)
+
+
+def test_submesh_pair_isolation(rt):
+    # SURVEY.md §7 hard part (a): a 2-device sub-mesh program where
+    # only the pair participates.
+    sub = rt.submesh([3, 6])
+    cache = C.CollectiveCache()
+    x = C.make_payload(sub, 64, jnp.int8)
+    fn = cache.permute(sub, "d", [(0, 1), (1, 0)])
+    y = _host(fn(x))
+    h = _host(x)
+    np.testing.assert_array_equal(y[0], h[1])
+    np.testing.assert_array_equal(y[1], h[0])
+
+
+def test_torus_axis_permute(rt2d):
+    # ppermute along one axis of a 2D mesh shifts independently per
+    # slice of the other axis — the 2D-torus workload's primitive.
+    cache = C.CollectiveCache()
+    x = C.make_payload(rt2d.mesh, 32, jnp.int8)
+    h = _host(x)  # shape (4, 2, 32)
+    fn = cache.permute(rt2d.mesh, "x", C.ring_edges(4))
+    y = _host(fn(x))
+    for i in range(4):
+        for j in range(2):
+            np.testing.assert_array_equal(y[(i + 1) % 4, j], h[i, j])
+
+
+def test_all_pairs_order():
+    pairs = list(C.all_pairs(3))
+    assert pairs == [(0, 0), (0, 1), (0, 2), (1, 0), (1, 1), (1, 2),
+                     (2, 0), (2, 1), (2, 2)]
+
+
+def test_out_of_range_edge_rejected(rt, cache):
+    # A bad edge must name itself, not surface as a raw IndexError
+    # from inside JAX (found during end-to-end verification).
+    with pytest.raises(ValueError, match=r"edge \(0, 99\) out of range"):
+        cache.permute(rt.mesh, "d", [(0, 99)])

@@ -1,0 +1,74 @@
+"""Config/flag-system tests; defaults must equal the reference's
+compile-time constants (p2p_matrix.cc:124,132,158)."""
+
+import pytest
+
+from tpu_p2p.config import (
+    BenchConfig,
+    REF_DTYPE,
+    REF_ITERS,
+    REF_MSG_SIZE,
+    format_size,
+    parse_size,
+    parse_sweep,
+)
+
+
+def test_defaults_are_reference_constants():
+    cfg = BenchConfig()
+    assert cfg.msg_size == 32 * 1024 * 1024 == REF_MSG_SIZE
+    assert cfg.iters == 128 == REF_ITERS
+    assert cfg.dtype == "int8" == REF_DTYPE
+    assert cfg.direction == "both"  # reference runs uni then bi
+    assert cfg.mode == "serialized"  # one message in flight, ever
+
+
+def test_parse_size():
+    assert parse_size("32MiB") == 32 * 1024 * 1024
+    assert parse_size("4KB") == 4000
+    assert parse_size("4KiB") == 4096
+    assert parse_size("1G") == 10**9
+    assert parse_size("1GiB") == 2**30
+    assert parse_size("8") == 8
+    assert parse_size(64) == 64
+    assert parse_size("1.5KiB") == 1536
+    with pytest.raises(ValueError):
+        parse_size("lots")
+
+
+def test_format_size():
+    assert format_size(32 * 1024 * 1024) == "32MiB"
+    assert format_size(2**30) == "1GiB"
+    assert format_size(8) == "8B"
+
+
+def test_parse_sweep_range_powers_of_two():
+    sizes = parse_sweep("1KiB:8KiB")
+    assert sizes == (1024, 2048, 4096, 8192)
+
+
+def test_parse_sweep_list():
+    assert parse_sweep("4KiB,32MiB") == (4096, 32 * 1024 * 1024)
+
+
+def test_invalid_enum_values_rejected():
+    with pytest.raises(ValueError):
+        BenchConfig(pattern="nope")
+    with pytest.raises(ValueError):
+        BenchConfig(mode="warp")
+    with pytest.raises(ValueError):
+        BenchConfig(direction="diag")
+    with pytest.raises(ValueError):
+        BenchConfig(iters=0)
+
+
+def test_sizes_prefers_sweep():
+    cfg = BenchConfig(sweep=(1024, 2048))
+    assert cfg.sizes() == (1024, 2048)
+    assert BenchConfig().sizes() == (REF_MSG_SIZE,)
+
+
+def test_replace():
+    cfg = BenchConfig().replace(iters=4, pattern="ring")
+    assert cfg.iters == 4 and cfg.pattern == "ring"
+    assert BenchConfig().iters == REF_ITERS

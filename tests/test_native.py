@@ -1,0 +1,63 @@
+"""Native C++ support-library tests: parity with the Python fallbacks.
+
+The reference's native surface is its whole program (Makefile:2); ours
+is the host-side support lib (clock, DJB2a, stats) — these tests pin
+the C and Python implementations to identical results."""
+
+import math
+
+import pytest
+
+from tpu_p2p.parallel import topology
+from tpu_p2p.utils import native
+
+
+requires_native = pytest.mark.skipif(
+    not native.available(), reason="native lib not built (make native)"
+)
+
+
+@requires_native
+def test_native_loaded():
+    assert native.available()
+
+
+@requires_native
+def test_djb2a_c_python_parity():
+    for s in ["", "a", "worker-0", "tpu-vm-3", "x" * 257]:
+        assert native.djb2a(s) == topology.djb2a_hash(s), s
+
+
+@requires_native
+def test_host_hash_matches_python():
+    assert native.host_hash() == topology.host_hash()
+
+
+@requires_native
+def test_monotonic_ns_advances():
+    a = native.monotonic_ns()
+    b = native.monotonic_ns()
+    assert b >= a > 0
+
+
+@requires_native
+def test_percentile_c_python_parity():
+    samples = [5.0, 1.0, 4.0, 2.0, 3.0]
+    from tpu_p2p.utils.timing import Samples
+
+    py = Samples(iter_seconds=samples)
+    for q in (0.0, 50.0, 99.0, 100.0):
+        assert native.percentile(samples, q) == py.percentile(q)
+
+
+@requires_native
+def test_stats_native():
+    s = native.stats([3.0, 1.0, 2.0])
+    assert s["mean"] == pytest.approx(2.0)
+    assert s["min"] == 1.0 and s["max"] == 3.0
+    assert s["p50"] == 2.0 and s["p99"] == 3.0
+
+
+def test_stats_empty_fallback():
+    s = native.stats([])
+    assert all(math.isnan(v) for v in s.values())

@@ -1,0 +1,101 @@
+"""L7 report-format tests: byte-parity with the reference's stdout
+contract (p2p_matrix.cc:133-140,143,147-151,179-184)."""
+
+import io
+import json
+import math
+
+from tpu_p2p.utils import report
+
+
+def test_header_bytes_exact():
+    buf = io.StringIO()
+    r = report.MatrixReporter(4, "Evaluating the Uni-Directional TPU P2P Bandwidth (Gbps)", buf)
+    r.header()
+    assert buf.getvalue() == (
+        "Evaluating the Uni-Directional TPU P2P Bandwidth (Gbps)\n"
+        "   D\\D     0      1      2      3 \n"
+    )
+
+
+def test_row_format_matches_reference_printf():
+    # "%6d " labels, "%6.02f " cells, 0.00 diagonal, newline per row.
+    buf = io.StringIO()
+    r = report.MatrixReporter(3, "t", buf)
+    r.row_label(0)
+    r.diagonal(0)
+    r.cell(0, 1, 123.456)
+    r.cell(0, 2, 7.0)
+    r.end_row()
+    assert buf.getvalue() == "     0   0.00 123.46   7.00 \n"
+
+
+def test_large_and_nan_cells():
+    buf = io.StringIO()
+    r = report.MatrixReporter(2, "t", buf)
+    r.cell(0, 1, 1234.5)  # wider than 6 chars — printf widens, same as C
+    assert "1234.50 " in buf.getvalue()
+    r.cell(1, 0, math.nan)
+    assert "nan" in buf.getvalue()
+
+
+def test_summary_off_diagonal_only():
+    r = report.MatrixReporter(3, "t", io.StringIO())
+    for i in range(3):
+        r.values[i][i] = 0.0
+    r.values[0][1] = 10.0
+    r.values[1][0] = 20.0
+    r.values[0][2] = 30.0
+    s = r.summary()
+    assert s["min"] == 10.0 and s["max"] == 30.0
+    assert s["avg"] == 20.0 and s["cells"] == 3
+
+
+def test_summary_empty():
+    r = report.MatrixReporter(2, "t", io.StringIO())
+    assert math.isnan(r.summary()["min"])
+
+
+def test_jsonl_roundtrip(tmp_path):
+    path = str(tmp_path / "cells.jsonl")
+    w = report.JsonlWriter(path)
+    rec = report.CellRecord(
+        workload="pairwise", direction="uni", src=0, dst=1,
+        msg_bytes=1024, iters=8, mode="serialized", gbps=12.5,
+        mean_s=1e-3, p50_s=1e-3, p99_s=2e-3, min_s=0.5e-3, hops=2,
+    )
+    w.write(rec)
+    w.close()
+    done = report.load_done_cells(path)
+    assert done[("pairwise", "uni", 0, 1, 1024, "serialized")] == 12.5
+
+
+def test_jsonl_resume_skips_torn_lines(tmp_path):
+    path = tmp_path / "cells.jsonl"
+    good = report.CellRecord(
+        workload="w", direction="uni", src=1, dst=2, msg_bytes=64,
+        iters=1, mode="fused", gbps=5.0,
+    ).to_json()
+    path.write_text(good + "\n{\"workload\": \"torn\n")
+    done = report.load_done_cells(str(path))
+    assert list(done) == [("w", "uni", 1, 2, 64, "fused")]
+
+
+def test_jsonl_writer_none_path_is_noop():
+    w = report.JsonlWriter(None)
+    w.write(
+        report.CellRecord(
+            workload="w", direction="uni", src=0, dst=1, msg_bytes=1,
+            iters=1, mode="serialized", gbps=1.0,
+        )
+    )
+    w.close()
+
+
+def test_cellrecord_extra_flattened():
+    rec = report.CellRecord(
+        workload="w", direction="uni", src=0, dst=1, msg_bytes=1,
+        iters=1, mode="serialized", gbps=1.0, extra={"axis": "x"},
+    )
+    d = json.loads(rec.to_json())
+    assert d["axis"] == "x" and "extra" not in d

@@ -1,0 +1,124 @@
+"""L6 timing tests with an injected fake clock (SURVEY.md §4 item 3).
+
+The reference's inline chrono reads (p2p_matrix.cc:153,174) become an
+injectable ``clock`` so the Gbps math and sample statistics are
+testable deterministically."""
+
+import math
+
+import pytest
+
+from tpu_p2p.utils import timing
+from tpu_p2p.utils.errors import TransferTimeout
+
+
+class FakeClock:
+    """Monotonic ns clock advancing by a scripted step per call."""
+
+    def __init__(self, step_ns=1_000_000):
+        self.t = 0
+        self.step = step_ns
+
+    def __call__(self):
+        self.t += self.step
+        return self.t
+
+
+def test_gbps_reference_formula():
+    # p2p_matrix.cc:177 — msg_size * 8 / time / 1e9.
+    msg = 32 * 1024 * 1024
+    assert timing.gbps(msg, 1.0) == pytest.approx(msg * 8 / 1e9)
+    # p2p_matrix.cc:258 — bi-directional doubles it.
+    assert timing.gbps(msg, 1.0, directions=2) == pytest.approx(2 * msg * 8 / 1e9)
+    # 32 MiB in 1 ms → ~268.44 Gbps.
+    assert timing.gbps(msg, 1e-3) == pytest.approx(268.435456)
+
+
+def test_gbps_degenerate():
+    assert math.isnan(timing.gbps(1, float("nan")))
+    assert math.isnan(timing.gbps(1, 0.0))
+
+
+def test_measure_serialized_with_fake_clock():
+    clock = FakeClock(step_ns=2_000_000)  # every clock read +2 ms
+    calls = []
+    s = timing.measure_serialized(
+        lambda x: calls.append(x) or x, 0, iters=4, warmup=2, clock=clock
+    )
+    assert len(calls) == 6  # 2 warmup + 4 timed
+    assert s.count == 4
+    # Each iteration: two clock reads 2 ms apart → 2 ms per sample.
+    assert all(t == pytest.approx(2e-3) for t in s.iter_seconds)
+    # Region: 9 reads spanning start..end → mean_region = region/4.
+    assert s.mean_region == pytest.approx(s.region_seconds / 4)
+    assert s.p50 == pytest.approx(2e-3)
+
+
+def test_samples_percentiles_nearest_rank():
+    s = timing.Samples(iter_seconds=[float(i) for i in range(1, 101)])
+    assert s.p50 == 50.0
+    assert s.p99 == 99.0
+    assert s.percentile(100.0) == 100.0
+    assert s.min == 1.0
+
+
+def test_samples_empty_nan():
+    s = timing.Samples()
+    assert math.isnan(s.mean) and math.isnan(s.p50) and math.isnan(s.mean_region)
+
+
+def test_measure_fused_normalizes_per_message():
+    clock = FakeClock(step_ns=8_000_000)  # 8 ms per read
+    s = timing.measure_fused(
+        lambda x: x, 0, iters=4, repeats=2, warmup=1, clock=clock
+    )
+    assert s.count == 2
+    # One chain call = 2 reads 8ms apart = 8ms for 4 messages → 2 ms each.
+    assert all(t == pytest.approx(2e-3) for t in s.iter_seconds)
+    # Fake clock advances on *every* read, so the fenced region spans 5
+    # reads (region start, 2×(t0, t1) pairs) = 40 ms for 2 repeats × 4
+    # messages → mean_region = 40/4/2 = 5 ms. Real clocks only differ
+    # from `mean` by clock-read overhead.
+    assert s.mean_region == pytest.approx(5e-3)
+
+
+def test_timeout_marks_sample(monkeypatch):
+    def hang(value, timeout_s):
+        raise TransferTimeout("wedged")
+
+    monkeypatch.setattr(timing, "_block", hang)
+    s = timing.measure_serialized(lambda x: x, 0, iters=4, warmup=0, timeout_s=0.01)
+    assert s.timed_out
+    assert math.isnan(s.mean_region)
+
+
+def test_block_real_timeout():
+    import threading
+
+    class Never:
+        def block_until_ready(self):
+            threading.Event().wait(10)
+
+    with pytest.raises(TransferTimeout):
+        timing._block(Never(), timeout_s=0.05)
+
+
+def test_barrier_called_around_region():
+    order = []
+    clock = FakeClock()
+    timing.measure_serialized(
+        lambda x: order.append("iter") or x,
+        0,
+        iters=2,
+        warmup=1,
+        clock=clock,
+        barrier=lambda: order.append("barrier"),
+    )
+    # warmup, then barrier, 2 iters, barrier — p2p_matrix.cc:146,173.
+    assert order == ["iter", "barrier", "iter", "iter", "barrier"]
+
+
+def test_default_clock_monotonic():
+    c = timing.default_clock()
+    a, b = c(), c()
+    assert b >= a

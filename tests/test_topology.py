@@ -1,0 +1,91 @@
+"""L2 placement-validation tests (reference: p2p_matrix.cc:44-100)."""
+
+import pytest
+
+from tpu_p2p.parallel import topology
+from tpu_p2p.utils.errors import PlacementError
+
+
+def test_djb2a_known_values():
+    # h = h*33 ^ c, seed 5381 — hand-computed parity values.
+    assert topology.djb2a_hash("") == 5381
+    assert topology.djb2a_hash("a") == (5381 * 33) ^ ord("a")
+    h = 5381
+    for c in b"worker-0":
+        h = ((h * 33) ^ c) & 0xFFFFFFFFFFFFFFFF
+    assert topology.djb2a_hash("worker-0") == h
+
+
+def test_djb2a_64bit_truncation():
+    # Long strings must wrap at 64 bits like the reference's uint64_t.
+    h = topology.djb2a_hash("x" * 1000)
+    assert 0 <= h < 2**64
+
+
+def test_hostname_strips_domain(monkeypatch):
+    monkeypatch.setattr(
+        topology.socket, "gethostname", lambda: "tpu-vm-3.europe-west4-a.internal"
+    )
+    assert topology.get_host_name() == "tpu-vm-3"
+
+
+def test_placement_single_host():
+    p = topology.validate_placement([7, 7, 7, 7])
+    assert p.num_hosts == 1 and p.devices_per_host == 4
+    assert p.local_ids == (0, 1, 2, 3)
+    assert p.host_of == (0, 0, 0, 0)
+
+
+def test_placement_two_hosts_contiguous():
+    # The example in the reference's own error text (p2p_matrix.cc:96):
+    # 8 processes, 2 nodes, first node 0-3, second 4-7.
+    p = topology.validate_placement([1, 1, 1, 1, 2, 2, 2, 2])
+    assert p.num_hosts == 2 and p.devices_per_host == 4
+    assert p.local_ids == (0, 1, 2, 3, 0, 1, 2, 3)
+    assert p.local_id(5) == 1
+
+
+def test_placement_nonuniform_rejected():
+    # p2p_matrix.cc:83-86 — size % num_hosts != 0.
+    with pytest.raises(PlacementError, match="same number of devices"):
+        topology.validate_placement([1, 1, 1, 2, 2])
+
+
+def test_placement_interleaved_rejected():
+    # p2p_matrix.cc:88-98 — round-robin (interleaved) placement rejected.
+    with pytest.raises(PlacementError, match="contiguous"):
+        topology.validate_placement([1, 2, 1, 2])
+
+
+def test_placement_split_host_rejected():
+    # Host 1 appears in two separate runs; with per_host=3 the first
+    # block [1,1,2] is mixed, so the contiguity loop rejects it.
+    with pytest.raises(PlacementError):
+        topology.validate_placement([1, 1, 2, 2, 1, 1])
+
+
+def test_placement_empty_rejected():
+    with pytest.raises(PlacementError):
+        topology.validate_placement([])
+
+
+def test_torus_hops():
+    t = topology.TorusInfo(
+        dims=(4, 2), coords=((0, 0), (1, 0), (2, 0), (3, 0), (0, 1), (1, 1), (2, 1), (3, 1))
+    )
+    assert t.hops(0, 1) == 1
+    assert t.hops(0, 3) == 1  # wraparound on the 4-extent axis
+    assert t.hops(0, 2) == 2
+    assert t.hops(0, 5) == 2  # one hop each axis
+    # 2-extent axis: distance 1 either way
+    assert t.hops(0, 4) == 1
+
+
+def test_torus_from_devices_cpu_is_none(rt):
+    # CPU devices expose no coords — graceful None.
+    assert topology.torus_from_devices(rt.devices) is None
+
+
+def test_placement_from_runtime(rt):
+    assert rt.placement.num_devices == 8
+    assert rt.placement.local_ids == tuple(range(8))

@@ -1,0 +1,27 @@
+"""tpu_p2p — a TPU-native interconnect microbenchmark framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference
+MPI+NCCL+CUDA point-to-point bandwidth benchmark
+(AmadeusChan/test-nccl-p2p, ``p2p_matrix.cc``): all-pairs uni- and
+bi-directional bandwidth matrices, plus ring / all_to_all / 2D-torus /
+latency / ring-attention workloads, measured over TPU ICI (and DCN for
+multi-slice meshes) using ``shard_map`` + ``jax.lax.ppermute`` (XLA
+``CollectivePermute``) instead of ``ncclSend``/``ncclRecv``.
+
+Layer map (mirrors SURVEY.md §1; reference citations in each module):
+
+- L1/L2/L3 bootstrap, placement validation, mesh & payload placement:
+  :mod:`tpu_p2p.parallel.runtime`, :mod:`tpu_p2p.parallel.topology`
+- L4 communication backend (edge-set collectives, compile cache):
+  :mod:`tpu_p2p.parallel.collectives`
+- L5 workloads: :mod:`tpu_p2p.workloads`
+- L6 timing/metrics: :mod:`tpu_p2p.utils.timing`
+- L7 reporting: :mod:`tpu_p2p.utils.report`
+- L8 error handling: :mod:`tpu_p2p.utils.errors`
+- config/CLI: :mod:`tpu_p2p.config`, :mod:`tpu_p2p.cli`
+"""
+
+__version__ = "0.1.0"
+
+from tpu_p2p.config import BenchConfig, parse_size  # noqa: F401
+from tpu_p2p.parallel.runtime import Runtime, make_runtime  # noqa: F401

@@ -1,0 +1,120 @@
+"""Config / flag system.
+
+The reference has none — zero CLI args and zero env reads; every
+parameter is a compile-time constant: ``msg_size = 32*1024*1024``
+(``/root/reference/p2p_matrix.cc:124``), ``count = 128`` (``:132``),
+dtype ``ncclInt8`` (``:158``), world size via ``mpirun -n``
+(``/root/reference/README.md:5``). SURVEY.md §5 mandates a real flag
+system for the BASELINE.json configs (message sweeps, patterns, mesh
+axes) with defaults that reproduce the reference's constants exactly.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field, fields
+from typing import Optional, Tuple
+
+# Reference constants (the defaults contract):
+REF_MSG_SIZE = 32 * 1024 * 1024  # p2p_matrix.cc:124
+REF_ITERS = 128  # p2p_matrix.cc:132
+REF_DTYPE = "int8"  # p2p_matrix.cc:158 (ncclInt8)
+
+_SIZE_RE = re.compile(r"^\s*([0-9]*\.?[0-9]+)\s*([KMGT]i?)?B?\s*$", re.IGNORECASE)
+_UNIT = {
+    None: 1,
+    "K": 10**3, "M": 10**6, "G": 10**9, "T": 10**12,
+    "KI": 2**10, "MI": 2**20, "GI": 2**30, "TI": 2**40,
+}
+
+
+def parse_size(text) -> int:
+    """Parse ``'32MiB'``, ``'4KB'``, ``'1G'``, ``'8'`` → bytes."""
+    if isinstance(text, int):
+        return text
+    m = _SIZE_RE.match(str(text))
+    if not m:
+        raise ValueError(f"unparseable size {text!r}")
+    num, unit = m.groups()
+    mult = _UNIT[unit.upper() if unit else None]
+    return int(float(num) * mult)
+
+
+def format_size(nbytes: int) -> str:
+    for unit, mult in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if nbytes % mult == 0 and nbytes >= mult:
+            return f"{nbytes // mult}{unit}"
+    return f"{nbytes}B"
+
+
+def parse_sweep(text: str) -> Tuple[int, ...]:
+    """``'1KiB:1GiB'`` → powers-of-two sweep; ``'4KB,32MiB'`` → list."""
+    if ":" in text:
+        lo_s, hi_s = text.split(":", 1)
+        lo, hi = parse_size(lo_s), parse_size(hi_s)
+        sizes = []
+        s = lo
+        while s <= hi:
+            sizes.append(s)
+            s *= 2
+        return tuple(sizes)
+    return tuple(parse_size(p) for p in text.split(","))
+
+
+PATTERNS = (
+    "pairwise",      # all-pairs matrix — the reference program itself
+    "loopback",      # self-edge / same-host copy (BASELINE configs[0])
+    "ring",          # shift-by-1 ppermute (configs[2])
+    "all_to_all",    # configs[3]
+    "torus2d",       # both mesh axes (configs[4])
+    "latency",       # 8B p50 send/recv latency (BASELINE metric)
+    "ring_attention",  # flagship SP workload over the same transport
+)
+
+MODES = ("serialized", "fused")  # SURVEY.md §7 hard part (c)
+ISOLATIONS = ("full", "submesh")  # SURVEY.md §7 hard part (a)
+DIRECTIONS = ("uni", "bi", "both")
+
+
+@dataclass
+class BenchConfig:
+    """Everything a run needs; defaults = the reference's constants."""
+
+    pattern: str = "pairwise"
+    msg_size: int = REF_MSG_SIZE
+    iters: int = REF_ITERS
+    warmup: int = 1  # deviation from reference (0 there): excludes XLA compile
+    dtype: str = REF_DTYPE
+    direction: str = "both"  # reference runs uni then bi (p2p_matrix.cc:141,196)
+    mode: str = "serialized"  # reference semantics: one message in flight
+    isolation: str = "full"
+    num_devices: Optional[int] = None
+    mesh_shape: Optional[Tuple[int, ...]] = None
+    sweep: Optional[Tuple[int, ...]] = None  # message-size sweep (configs[1])
+    fused_repeats: int = 3
+    timeout_s: Optional[float] = None
+    check: bool = False  # verify payload contents after transfer
+    jsonl: Optional[str] = None  # structured twin of the stdout matrix
+    resume: bool = False  # skip cells already present in jsonl
+    seed: int = 0
+    profile_dir: Optional[str] = None  # jax.profiler trace output
+
+    def __post_init__(self) -> None:
+        if self.pattern not in PATTERNS:
+            raise ValueError(f"pattern {self.pattern!r} not in {PATTERNS}")
+        if self.mode not in MODES:
+            raise ValueError(f"mode {self.mode!r} not in {MODES}")
+        if self.isolation not in ISOLATIONS:
+            raise ValueError(f"isolation {self.isolation!r} not in {ISOLATIONS}")
+        if self.direction not in DIRECTIONS:
+            raise ValueError(f"direction {self.direction!r} not in {DIRECTIONS}")
+        if self.iters <= 0:
+            raise ValueError("iters must be positive")
+
+    def sizes(self) -> Tuple[int, ...]:
+        return self.sweep if self.sweep else (self.msg_size,)
+
+    def replace(self, **kw) -> "BenchConfig":
+        d = {f.name: getattr(self, f.name) for f in fields(self)}
+        d.update(kw)
+        return BenchConfig(**d)

@@ -1,0 +1,256 @@
+"""L4 — the communication backend: edge-set collectives over the mesh.
+
+TPU-native equivalent of the reference's NCCL data plane:
+
+- ``ncclSend``/``ncclRecv`` of ``ncclInt8``
+  (``/root/reference/p2p_matrix.cc:156-171``) → a ``shard_map``-wrapped
+  ``jax.lax.ppermute`` (XLA ``CollectivePermute`` over ICI/DCN) carrying
+  an arbitrary ordered-edge list. A uni-directional pair transfer is the
+  single edge ``[(src, dst)]``.
+- ``ncclGroupStart``/``ncclGroupEnd`` fusing a send+recv into one
+  full-duplex op on two streams (``p2p_matrix.cc:211-251``) → the *same*
+  ``ppermute`` with both directed edges ``[(src, dst), (dst, src)]`` —
+  XLA's CollectivePermute is natively full-duplex, so the group
+  construct and the second stream dissolve (SURVEY.md §3.4).
+- ``cudaMalloc`` + ``cudaMemset(0)`` buffers (``p2p_matrix.cc:124-130``)
+  → :func:`make_payload` device-placed ``jax.Array``s. Unlike the
+  reference's zeroed buffers, payloads are rank-tagged so transfers are
+  *verifiable* (:func:`expected_permute`, SURVEY.md §4 item 2).
+- ``cudaStreamSynchronize`` completion (``p2p_matrix.cc:162,170``) →
+  ``jax.block_until_ready`` at the call sites in
+  :mod:`tpu_p2p.utils.timing`.
+
+Everything here is compiled once per (mesh, edge-set, shape, dtype,
+chain length) and cached — XLA compile time must never land inside a
+timed region (SURVEY.md §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Edge = Tuple[int, int]
+
+# Multiplicative rank tag; coprime with 256 so per-rank patterns are
+# distinct in int8. Verification replaces the reference's unchecked
+# zero buffers (p2p_matrix.cc:129-130).
+_TAG_STRIDE = 131
+
+
+def dtype_of(name) -> np.dtype:
+    return np.dtype(name)
+
+
+def elems_for(msg_bytes: int, dtype) -> int:
+    """Element count for a payload of ``msg_bytes`` bytes."""
+    itemsize = np.dtype(dtype).itemsize
+    if msg_bytes % itemsize:
+        raise ValueError(f"msg size {msg_bytes}B not divisible by {dtype} itemsize")
+    return max(1, msg_bytes // itemsize)
+
+
+def _payload_np(mesh_shape: Tuple[int, ...], elems: int, dtype) -> np.ndarray:
+    """Rank-tagged host payload: device ``r``'s row is
+    ``(r * 131 + iota) mod 256`` reinterpreted in ``dtype``."""
+    n = int(np.prod(mesh_shape))
+    nbytes = elems * np.dtype(dtype).itemsize
+    rows = np.empty((n, nbytes), dtype=np.uint8)
+    iota = np.arange(nbytes, dtype=np.uint64)
+    for r in range(n):
+        rows[r] = ((r * _TAG_STRIDE + iota) % 256).astype(np.uint8)
+    return rows.view(dtype).reshape(mesh_shape + (elems,))
+
+
+def payload_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading mesh-axes-sharded, trailing payload dim replicated."""
+    return NamedSharding(mesh, P(*mesh.axis_names, None))
+
+
+def make_payload(mesh: Mesh, msg_bytes: int, dtype=jnp.int8) -> jax.Array:
+    """Device-resident send buffer, one row per mesh device.
+
+    The ``cudaMalloc``+``cudaMemset`` of ``p2p_matrix.cc:124-130``,
+    except rank-tagged (see module docstring) and laid out as a single
+    global array sharded one-row-per-device, which is the idiomatic XLA
+    shape for a per-device buffer.
+    """
+    host = _payload_np(mesh.devices.shape, elems_for(msg_bytes, dtype), dtype)
+    return jax.device_put(host, payload_sharding(mesh))
+
+
+def expected_permute(x: np.ndarray, edges: Sequence[Edge], axis: int = 0) -> np.ndarray:
+    """Reference semantics of one ``ppermute`` application on the host.
+
+    Rows with no incoming edge become zeros (XLA CollectivePermute
+    semantics); row ``dst`` receives row ``src`` for each edge.
+    """
+    out = np.zeros_like(x)
+    idx = [slice(None)] * x.ndim
+    for src, dst in edges:
+        dst_idx, src_idx = list(idx), list(idx)
+        dst_idx[axis], src_idx[axis] = dst, src
+        out[tuple(dst_idx)] = x[tuple(src_idx)]
+    return out
+
+
+def _canon_edges(edges: Sequence[Edge], axis_size: int) -> Tuple[Edge, ...]:
+    canon = tuple((int(s), int(d)) for s, d in edges)
+    dsts = [d for _, d in canon]
+    if len(set(dsts)) != len(dsts):
+        raise ValueError(f"duplicate destination in edge set {canon}")
+    for s, d in canon:
+        if not (0 <= s < axis_size and 0 <= d < axis_size):
+            raise ValueError(
+                f"edge ({s}, {d}) out of range for axis of size {axis_size}"
+            )
+    return canon
+
+
+class CollectiveCache:
+    """Compile-once cache of jitted collective programs.
+
+    The reference pays NCCL communicator setup once (``p2p_matrix.cc:120``)
+    and nothing per pair; XLA instead pays one compilation per
+    (edge-set template, shape, dtype) — this cache plus explicit warm-up
+    keeps that cost out of timed regions (SURVEY.md §7 hard part (b)).
+    """
+
+    def __init__(self) -> None:
+        self._cache: Dict[tuple, object] = {}
+
+    def _get(self, key, builder):
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = builder()
+            self._cache[key] = fn
+        return fn
+
+    # -- point-to-point / permutation ------------------------------------
+
+    def permute(self, mesh: Mesh, axis: str, edges: Sequence[Edge]):
+        """One ``ppermute`` applying ``edges`` along mesh axis ``axis``.
+
+        ``[(src, dst)]`` ≙ the blocking ``ncclSend``/``ncclRecv`` pair of
+        ``p2p_matrix.cc:156-171``; ``[(src, dst), (dst, src)]`` ≙ the
+        grouped full-duplex exchange of ``p2p_matrix.cc:211-251``.
+        """
+        edges = _canon_edges(edges, mesh.shape[axis])
+        key = ("permute", mesh, axis, edges)
+
+        def build():
+            spec = P(*mesh.axis_names, None)
+
+            def f(x):
+                return jax.lax.ppermute(x, axis, edges)
+
+            return jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+            )
+
+        return self._get(key, build)
+
+    def permute_chain(self, mesh: Mesh, axis: str, edges: Sequence[Edge], count: int):
+        """``count`` back-to-back ``ppermute``\\ s compiled as one program.
+
+        Each hop's input is the previous hop's output (a real data
+        dependency), so the device serializes the messages without any
+        host round-trip — the "fused" timing mode. The host-loop
+        serialized mode (one jitted hop per Python iteration, drained
+        each time) reproduces the reference's one-message-in-flight
+        semantics (``p2p_matrix.cc:154-171``); see SURVEY.md §7 hard
+        part (c) for why both modes exist.
+        """
+        edges = _canon_edges(edges, mesh.shape[axis])
+        key = ("chain", mesh, axis, edges, count)
+
+        def build():
+            spec = P(*mesh.axis_names, None)
+
+            def f(x):
+                def step(carry, _):
+                    return jax.lax.ppermute(carry, axis, edges), None
+
+                out, _ = jax.lax.scan(step, x, None, length=count)
+                return out
+
+            return jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+            )
+
+        return self._get(key, build)
+
+    # -- all-to-all ------------------------------------------------------
+
+    def all_to_all(self, mesh: Mesh, axis: str):
+        """Tiled ``all_to_all`` along ``axis`` — the transport of
+        Ulysses-style sequence parallelism and expert parallelism
+        (SURVEY.md §2.3; BASELINE.json configs[3]).
+
+        Operates on the standard payload layout: each device's local
+        row is split into ``axis_size`` equal chunks along the payload
+        dim; chunk ``j`` goes to device ``j``.
+        """
+        key = ("a2a", mesh, axis)
+
+        def build():
+            spec = P(*mesh.axis_names, None)
+
+            def f(x):
+                # x local: (1, ..., elems); exchange along payload dim.
+                return jax.lax.all_to_all(
+                    x, axis, split_axis=x.ndim - 1, concat_axis=x.ndim - 1, tiled=True
+                )
+
+            return jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+            )
+
+        return self._get(key, build)
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+
+def expected_all_to_all(x: np.ndarray, axis_size: int) -> np.ndarray:
+    """Host semantics of the tiled all_to_all above: with rows as
+    devices and the payload dim split into ``axis_size`` chunks,
+    output[i] chunk j == input[j] chunk i."""
+    n = axis_size
+    rows, elems = x.shape[0], x.shape[-1]
+    assert rows == n and elems % n == 0
+    chunks = x.reshape(n, n, elems // n)  # [device, chunk, payload/n]
+    return np.swapaxes(chunks, 0, 1).reshape(x.shape)
+
+
+# Edge-set constructors for the named workload patterns (SURVEY.md §5
+# "long-context / sequence parallelism": these patterns are the
+# transports of ring-CP / Ulysses / torus strategies).
+
+
+def unidir_edges(src: int, dst: int) -> Tuple[Edge, ...]:
+    """p2p_matrix.cc:156-171 — one ordered pair."""
+    return ((src, dst),)
+
+
+def bidir_edges(a: int, b: int) -> Tuple[Edge, ...]:
+    """p2p_matrix.cc:211-251 — grouped send+recv, both directions."""
+    return ((a, b), (b, a))
+
+
+def ring_edges(n: int, shift: int = 1) -> Tuple[Edge, ...]:
+    """Shift-by-``shift`` ring — ring attention / ring-CP transport
+    (BASELINE.json configs[2])."""
+    return tuple((i, (i + shift) % n) for i in range(n))
+
+
+def all_pairs(n: int):
+    """The reference's pair sweep order (p2p_matrix.cc:141-145):
+    row-major over ordered (src, dst), diagonal included."""
+    for src in range(n):
+        for dst in range(n):
+            yield src, dst

@@ -1,0 +1,150 @@
+"""L1 + L3 — process bootstrap, device mesh, and synchronization.
+
+TPU-native equivalent of the reference's MPI bootstrap and CUDA device
+management:
+
+- ``MPI_Init_thread`` / rank & world-size discovery
+  (``/root/reference/p2p_matrix.cc:105-108``) →
+  :func:`init_distributed` (``jax.distributed.initialize()`` on
+  multi-host TPU slices) + JAX's global device enumeration.
+- ``ncclGetUniqueId`` + ``MPI_Bcast`` + ``ncclCommInitRank`` rendezvous
+  (``p2p_matrix.cc:115-120``) → the JAX coordinator performs rendezvous
+  inside ``jax.distributed.initialize``; the world-spanning communicator
+  is the :class:`jax.sharding.Mesh` built here.
+- ``cudaSetDevice`` / ``cudaMalloc`` / ``cudaMemset`` / streams
+  (``p2p_matrix.cc:119-130``) → device-placed ``jax.Array`` payloads
+  (see :mod:`tpu_p2p.parallel.collectives`); XLA owns async dispatch, so
+  the two non-blocking streams have no user-visible analogue — the
+  full-duplex trick they enable is a single two-edge ``ppermute``
+  (SURVEY.md §3.4).
+- ``MPI_Barrier`` (``p2p_matrix.cc:146,173,201,254,271``) →
+  :meth:`Runtime.barrier`.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from tpu_p2p.parallel import topology
+from tpu_p2p.utils.errors import check
+
+MESH_AXIS = "d"  # canonical 1D benchmark axis name
+MESH_AXES_2D = ("x", "y")  # canonical 2D-torus axis names
+
+
+def init_distributed(force: bool = False) -> bool:
+    """Join the multi-host job, if there is one.
+
+    Equivalent of ``MPI_Init_thread`` + the NCCL-id broadcast
+    (``p2p_matrix.cc:105-118``): ``jax.distributed.initialize()``
+    performs coordinator rendezvous on TPU VM slices, after which
+    ``jax.devices()`` spans all hosts. Off-cluster (single process, CPU
+    tests) this is a no-op — returns False.
+    """
+    if jax.distributed.is_initialized():
+        return True  # launcher or caller already did the rendezvous
+    in_tpu_pod = any(
+        v in os.environ
+        for v in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    if force or in_tpu_pod:
+        # Must run before anything instantiates the XLA backend — JAX
+        # refuses otherwise. Callers should make_runtime() before any
+        # other jax call, mirroring MPI_Init being main()'s first act.
+        jax.distributed.initialize()
+        return True
+    return False
+
+
+@dataclass
+class Runtime:
+    """A validated device world + mesh — the framework's ``ncclComm_t``.
+
+    Bundles what the reference threads through ``main`` as loose state:
+    rank/world (``p2p_matrix.cc:107-108``), the placement-derived local
+    device id (``:109``), and the communicator (``:120``).
+    """
+
+    devices: Tuple
+    mesh: Mesh
+    placement: topology.Placement
+    torus: Optional[topology.TorusInfo]
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    def submesh(self, device_ids: Sequence[int], axis: str = MESH_AXIS) -> Mesh:
+        """A mesh over a subset of devices (pair-isolation mode —
+        SURVEY.md §7 hard part (a))."""
+        devs = np.array([self.devices[i] for i in device_ids])
+        return Mesh(devs, (axis,))
+
+    def barrier(self, tag: str = "tpu_p2p") -> None:
+        """Global synchronization point.
+
+        Parity with ``MPI_Barrier(MPI_COMM_WORLD)``
+        (``p2p_matrix.cc:146,173,201,254,271``). Multi-host: a true
+        cross-host sync via ``multihost_utils``. Single-process: every
+        dispatched computation is ordered per-device by XLA, so draining
+        a trivial computation on each mesh device is a sufficient fence.
+        """
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(tag)
+            return
+        for d in self.devices:
+            jax.device_put(np.int32(0), d).block_until_ready()
+
+
+def make_runtime(
+    num_devices: Optional[int] = None,
+    mesh_shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Optional[Tuple[str, ...]] = None,
+    devices=None,
+) -> Runtime:
+    """Bootstrap → validate placement → build the mesh.
+
+    The TPU analogue of ``main``'s setup block
+    (``p2p_matrix.cc:105-122``): join the job, enumerate devices, check
+    placement invariants, and construct the world-spanning communicator
+    (here: a :class:`Mesh`).
+
+    ``mesh_shape``/``axis_names`` default to a 1D mesh ``("d",)`` over
+    all devices; pass e.g. ``(4, 2), ("x", "y")`` for the 2D-torus
+    workload (BASELINE.json configs[4]).
+    """
+    init_distributed()
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        check(
+            num_devices <= len(devices),
+            f"requested {num_devices} devices but only {len(devices)} visible",
+        )
+        devices = devices[:num_devices]
+    devices = tuple(devices)
+    placement = topology.placement_from_devices(devices)
+    torus = topology.torus_from_devices(devices)
+    if mesh_shape is None:
+        mesh_shape = (len(devices),)
+        axis_names = axis_names or (MESH_AXIS,)
+    else:
+        check(
+            int(np.prod(mesh_shape)) == len(devices),
+            f"mesh shape {mesh_shape} != {len(devices)} devices",
+        )
+        axis_names = axis_names or MESH_AXES_2D[: len(mesh_shape)]
+    mesh = Mesh(np.array(devices).reshape(mesh_shape), axis_names)
+    return Runtime(devices=devices, mesh=mesh, placement=placement, torus=torus)

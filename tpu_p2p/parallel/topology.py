@@ -1,0 +1,176 @@
+"""L2 — topology discovery and placement validation.
+
+TPU-native equivalent of ``check_process_placement_policy`` and its
+helpers (``/root/reference/p2p_matrix.cc:44-100``). The reference
+all-gathers a DJB2a hash of each rank's hostname, derives the host
+count, and asserts (a) every host runs the same number of processes and
+(b) ranks on one host form a contiguous block; it returns
+``rank % procs_per_host`` as the local device id (``p2p_matrix.cc:99``).
+
+On TPU, JAX already enumerates devices with a stable global order and a
+``process_index`` per device, so no hostname gossip is needed — but the
+*invariants* still deserve asserting (a surprising placement silently
+skews a bandwidth matrix). :func:`validate_placement` checks the same
+two invariants over ``jax.devices()`` and produces the same
+global↔local mapping. The DJB2a hash and hostname truncation are kept
+(:func:`djb2a_hash`, :func:`get_host_name`) both for capability parity
+and because the hash is a convenient stable host key for reports.
+
+This module also owns physical-topology introspection (ICI torus
+coordinates and hop distances), which the reference cannot see (NCCL
+hides topology) but which a TPU matrix report should annotate — the ICI
+fabric is a torus, so cells stratify by hop count (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import socket
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from tpu_p2p.utils.errors import PlacementError
+
+# Messages mirror the reference's stderr diagnostics (p2p_matrix.cc:84,96),
+# reworded for devices/hosts instead of MPI processes.
+_MSG_NONUNIFORM = (
+    "Please make sure that each host has the same number of devices"
+)
+_MSG_NONCONTIGUOUS = (
+    "Please make sure that devices are placed in contiguous per-host blocks. "
+    "For example, if there are 8 devices and 2 hosts, the first host should "
+    "hold devices 0-3 while the second host holds devices 4-7."
+)
+
+
+def djb2a_hash(s: str) -> int:
+    """DJB2a string hash: ``h = h*33 ^ c``, seed 5381.
+
+    Bit-for-bit parity with ``getHostHash`` (``p2p_matrix.cc:44-51``),
+    truncated to 64 bits like the reference's ``uint64_t``.
+    """
+    h = 5381
+    for ch in s.encode():
+        h = ((h << 5) + h) ^ ch
+        h &= 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def get_host_name() -> str:
+    """Hostname with the domain stripped at the first ``.``.
+
+    Parity with ``getHostName`` (``p2p_matrix.cc:53-61``).
+    """
+    return socket.gethostname().split(".", 1)[0]
+
+
+def host_hash() -> int:
+    """This host's DJB2a hostname hash (``p2p_matrix.cc:68-69``)."""
+    return djb2a_hash(get_host_name())
+
+
+@dataclass(frozen=True)
+class Placement:
+    """Validated device placement — the return value of the reference's
+    placement check, generalized.
+
+    ``local_ids[i]`` is the local index of global device ``i`` on its
+    host — the reference's ``mpi_rank % num_gpu_per_host``
+    (``p2p_matrix.cc:99``).
+    """
+
+    num_devices: int
+    num_hosts: int
+    devices_per_host: int
+    host_of: tuple  # host ordinal per global device id
+    local_ids: tuple  # local index per global device id
+
+    def local_id(self, global_id: int) -> int:
+        return self.local_ids[global_id]
+
+
+def validate_placement(host_keys: Sequence[int]) -> Placement:
+    """Validate per-device host assignment; return the global↔local map.
+
+    ``host_keys[i]`` is an opaque host identifier for global device
+    ``i`` — ``device.process_index`` in JAX, or a hostname hash in the
+    reference's world (``p2p_matrix.cc:70-76`` allgathers exactly this).
+
+    Checks, in reference order:
+    1. uniform devices per host (``p2p_matrix.cc:83-86``),
+    2. contiguous per-host blocks (``p2p_matrix.cc:88-98``).
+
+    Raises :class:`PlacementError` (the reference ``exit(-1)``\\ s).
+    """
+    n = len(host_keys)
+    if n == 0:
+        raise PlacementError("no devices visible")
+    distinct = list(dict.fromkeys(host_keys))  # order-preserving unique
+    num_hosts = len(distinct)
+    if n % num_hosts != 0:
+        raise PlacementError(_MSG_NONUNIFORM)
+    per_host = n // num_hosts
+    # Contiguity check — same loop structure as p2p_matrix.cc:89-94:
+    # within each block of `per_host` global ids, all host keys equal.
+    contiguous = True
+    for host in range(num_hosts):
+        base = host * per_host
+        for k in range(1, per_host):
+            contiguous = contiguous and (
+                host_keys[base + k] == host_keys[base + k - 1]
+            )
+    if not contiguous:
+        raise PlacementError(_MSG_NONCONTIGUOUS)
+    host_of = tuple(i // per_host for i in range(n))
+    local_ids = tuple(i % per_host for i in range(n))
+    return Placement(
+        num_devices=n,
+        num_hosts=num_hosts,
+        devices_per_host=per_host,
+        host_of=host_of,
+        local_ids=local_ids,
+    )
+
+
+def placement_from_devices(devices) -> Placement:
+    """:func:`validate_placement` over JAX devices' ``process_index``."""
+    return validate_placement([d.process_index for d in devices])
+
+
+# ---------------------------------------------------------------------------
+# Physical ICI topology (additive vs. the reference — SURVEY.md §5).
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TorusInfo:
+    """Physical torus shape + per-device coordinates, when exposed."""
+
+    dims: tuple  # torus extent per axis, e.g. (4, 4, 1)
+    coords: tuple = field(default=())  # per-device coordinate tuples
+
+    def hops(self, a: int, b: int) -> int:
+        """Minimal ICI hop count between devices ``a`` and ``b``
+        (wraparound torus Manhattan distance)."""
+        total = 0
+        for axis, extent in enumerate(self.dims):
+            d = abs(self.coords[a][axis] - self.coords[b][axis])
+            if extent > 1:
+                d = min(d, extent - d)
+            total += d
+        return total
+
+
+def torus_from_devices(devices) -> Optional[TorusInfo]:
+    """Extract torus coordinates from TPU devices, or None off-TPU.
+
+    TPU devices expose ``.coords`` (x, y, z); CPU/GPU devices do not —
+    callers fall back to hop-agnostic reporting.
+    """
+    coords = []
+    for d in devices:
+        c = getattr(d, "coords", None)
+        if c is None:
+            return None
+        coords.append(tuple(c))
+    dims = tuple(max(c[axis] for c in coords) + 1 for axis in range(len(coords[0])))
+    return TorusInfo(dims=dims, coords=tuple(coords))

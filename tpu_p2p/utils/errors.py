@@ -1,0 +1,107 @@
+"""L8 — fail-fast error handling (cross-cutting).
+
+TPU-native equivalent of the reference's three check macros
+``MPICHECK`` / ``CUDACHECK`` / ``NCCLCHECK``
+(``/root/reference/p2p_matrix.cc:15-42``), which print
+``Failed: <backend> error <file>:<line> '<err>'`` and
+``exit(EXIT_FAILURE)``, and of the topology-violation
+``exit(-1)`` paths (``p2p_matrix.cc:85,97``).
+
+In a Python framework the idiomatic shape is typed exceptions raised at
+the failure site (carrying the caller's file:line, like ``__FILE__`` /
+``__LINE__`` in the macros) plus a single CLI-level handler
+(:func:`fail_fast`) that formats and exits — same fail-fast observable
+behavior, one handler instead of 34 macro call sites.
+"""
+
+from __future__ import annotations
+
+import sys
+import traceback
+from contextlib import contextmanager
+
+
+class TpuP2PError(RuntimeError):
+    """Base class for all framework errors."""
+
+
+class PlacementError(TpuP2PError):
+    """Topology/placement invariant violated.
+
+    Parity: the ``exit(-1)`` paths of ``check_process_placement_policy``
+    (``p2p_matrix.cc:83-86`` non-uniform processes per host;
+    ``p2p_matrix.cc:88-98`` non-contiguous per-host rank blocks).
+    """
+
+
+class BackendError(TpuP2PError):
+    """A JAX/XLA-level operation failed.
+
+    Parity: ``NCCLCHECK``/``CUDACHECK`` (``p2p_matrix.cc:25-42``) — any
+    device/collective call failing is fatal to the benchmark.
+    """
+
+
+class TransferTimeout(TpuP2PError):
+    """A timed transfer exceeded its watchdog deadline.
+
+    Strictly additive vs. the reference, which hangs at the next
+    ``MPI_Barrier`` if a link wedges (SURVEY.md §5 failure detection):
+    we detect the wedge and surface it as a marked cell instead.
+    """
+
+
+def _caller_site(depth: int = 2) -> str:
+    """``file:line`` of the calling frame — the ``__FILE__:__LINE__`` of
+    the macros at ``p2p_matrix.cc:18,28,38``."""
+    frame = sys._getframe(depth)
+    return f"{frame.f_code.co_filename}:{frame.f_lineno}"
+
+
+def check(cond: bool, msg: str, exc: type = TpuP2PError) -> None:
+    """Assert a runtime invariant, failing with the call site attached.
+
+    Equivalent of the reference's single bare ``assert``
+    (``p2p_matrix.cc:106``) and the macro checks, as a function.
+    """
+    if not cond:
+        raise exc(f"Failed: {msg} at {_caller_site()}")
+
+
+@contextmanager
+def checked(what: str):
+    """Wrap a backend call so failures carry context + call site.
+
+    Usage parity with ``NCCLCHECK(ncclSend(...))``::
+
+        with checked("ppermute dispatch"):
+            out = fn(x)
+    """
+    site = _caller_site(3)  # capture at entry: 0=_caller_site, 1=checked,
+    # 2=contextmanager.__enter__, 3=the user's `with` statement
+    try:
+        yield
+    except TpuP2PError:
+        raise
+    except Exception as e:  # noqa: BLE001 — deliberate catch-all, macro parity
+        raise BackendError(
+            f"Failed: {what} error {site} '{type(e).__name__}: {e}'"
+        ) from e
+
+
+def fail_fast(e: BaseException, *, stream=None) -> "int":
+    """CLI-level handler: print like the reference macros, return exit code.
+
+    Topology errors go to stderr with exit code 255 (two's-complement of
+    the reference's ``exit(-1)``, ``p2p_matrix.cc:85,97``); everything
+    else prints the macro-style ``Failed: ...`` line and returns 1
+    (``EXIT_FAILURE``, ``p2p_matrix.cc:20,30,40``).
+    """
+    stream = stream if stream is not None else sys.stderr
+    if isinstance(e, PlacementError):
+        print(str(e), file=stream)
+        return 255
+    print(f"Failed: {type(e).__name__} '{e}'", file=stream)
+    if not isinstance(e, TpuP2PError):
+        traceback.print_exception(e, file=stream)
+    return 1

@@ -1,0 +1,142 @@
+"""ctypes bindings to the native C++ support library.
+
+The reference is a single natively-compiled C++ program
+(``/root/reference/Makefile:2``). On TPU the data plane is XLA itself
+(SURVEY.md §2.2 — re-linking NCCL has no analogue), so the native
+surface that *remains* native here is the runtime support the C++
+program got from libc/chrono for free and the hot host-side paths:
+
+- monotonic nanosecond clock (``clock_gettime(CLOCK_MONOTONIC)``) —
+  replaces the reference's ``std::chrono::system_clock`` reads
+  (``p2p_matrix.cc:153,174``) with a step-free clock;
+- DJB2a hostname hashing (bit-parity with ``getHostHash``,
+  ``p2p_matrix.cc:44-51``) and hostname truncation (``:53-61``);
+- sorting-based percentile/stat kernels over per-iteration samples
+  (the reference keeps only a mean, ``:176``; BASELINE.json wants p50).
+
+Built by ``make native`` into ``native/libtpu_p2p_native.so`` (see
+``/root/repo/native/tpu_p2p_native.cc``). Loaded lazily; every entry
+point has a pure-Python fallback so the framework runs unbuilt — the
+bindings are ``ctypes`` because pybind11 is unavailable in this image.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import time
+from typing import Optional, Sequence
+
+_LIB_ENV = "TPU_P2P_NATIVE_LIB"
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _candidates():
+    if os.environ.get(_LIB_ENV):
+        yield os.environ[_LIB_ENV]
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    yield os.path.join(here, "native", "libtpu_p2p_native.so")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    for path in _candidates():
+        if not os.path.exists(path):
+            continue
+        try:
+            lib = ctypes.CDLL(path)
+            lib.tpu_p2p_monotonic_ns.restype = ctypes.c_uint64
+            lib.tpu_p2p_djb2a.argtypes = [ctypes.c_char_p]
+            lib.tpu_p2p_djb2a.restype = ctypes.c_uint64
+            lib.tpu_p2p_host_hash.restype = ctypes.c_uint64
+            lib.tpu_p2p_percentile.argtypes = [
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_size_t,
+                ctypes.c_double,
+            ]
+            lib.tpu_p2p_percentile.restype = ctypes.c_double
+            lib.tpu_p2p_stats.argtypes = [
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_double),
+            ]
+            lib.tpu_p2p_stats.restype = None
+            _lib = lib
+            break
+        except OSError:
+            continue
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def monotonic_ns() -> int:
+    lib = _load()
+    if lib is not None:
+        return int(lib.tpu_p2p_monotonic_ns())
+    return time.perf_counter_ns()
+
+
+def djb2a(s: str) -> int:
+    lib = _load()
+    if lib is not None:
+        return int(lib.tpu_p2p_djb2a(s.encode()))
+    from tpu_p2p.parallel.topology import djb2a_hash
+
+    return djb2a_hash(s)
+
+
+def host_hash() -> int:
+    lib = _load()
+    if lib is not None:
+        return int(lib.tpu_p2p_host_hash())
+    from tpu_p2p.parallel import topology
+
+    return topology.host_hash()
+
+
+def percentile(samples: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile (matches timing.Samples.percentile)."""
+    lib = _load()
+    arr = (ctypes.c_double * len(samples))(*samples)
+    if lib is not None and len(samples):
+        return float(lib.tpu_p2p_percentile(arr, len(samples), q))
+    import math
+
+    s = sorted(samples)
+    if not s:
+        return math.nan
+    rank = max(0, min(len(s) - 1, math.ceil(q / 100.0 * len(s)) - 1))
+    return s[rank]
+
+
+def stats(samples: Sequence[float]) -> dict:
+    """{mean, min, max, p50, p99} in one native pass, or Python fallback."""
+    import math
+
+    if not samples:
+        return {k: math.nan for k in ("mean", "min", "max", "p50", "p99")}
+    lib = _load()
+    if lib is not None:
+        arr = (ctypes.c_double * len(samples))(*samples)
+        out = (ctypes.c_double * 5)()
+        lib.tpu_p2p_stats(arr, len(samples), out)
+        return dict(zip(("mean", "min", "max", "p50", "p99"), out))
+    s = sorted(samples)
+
+    def nr(q):
+        return s[max(0, min(len(s) - 1, math.ceil(q / 100.0 * len(s)) - 1))]
+
+    return {
+        "mean": sum(s) / len(s),
+        "min": s[0],
+        "max": s[-1],
+        "p50": nr(50.0),
+        "p99": nr(99.0),
+    }

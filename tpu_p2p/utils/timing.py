@@ -1,0 +1,254 @@
+"""L6 — timing and metrics.
+
+TPU-native equivalent of the reference's measurement core: host
+wall-clock bracketing of a barrier-fenced transfer loop
+(``/root/reference/p2p_matrix.cc:153,174-177`` uni;
+``:208,255-258`` bi), with three deliberate upgrades flagged in
+SURVEY.md §5/§6:
+
+1. **Monotonic clock.** The reference uses
+   ``std::chrono::system_clock`` (wall time — NTP steps skew results);
+   we use a monotonic nanosecond clock (native C++ ``clock_gettime``
+   via :mod:`tpu_p2p.utils.native` when built, else
+   ``time.perf_counter_ns``).
+2. **Per-iteration samples.** The reference keeps only the mean over
+   128 iterations (``p2p_matrix.cc:176``); we retain every sample so
+   p50/p99 exist (BASELINE.json's p50-latency metric requires them).
+   The mean over the whole fenced region still reproduces the
+   reference's number exactly.
+3. **Warm-up.** XLA compiles on first call; warm-up iterations are
+   mandatory before timing or the first cell absorbs compile time
+   (SURVEY.md §5 "distributed communication backend" difference (b)).
+   The reference needs none (NCCL setup happens at init).
+
+Completion semantics: ``jax.block_until_ready`` is the analogue of
+``cudaStreamSynchronize`` (``p2p_matrix.cc:162,170,229-230,250-251``).
+
+Failure detection (additive — SURVEY.md §5): a watchdog thread turns a
+wedged link into a :class:`~tpu_p2p.utils.errors.TransferTimeout`
+instead of the reference's behavior of hanging the job at the next
+``MPI_Barrier``.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+import jax
+
+from tpu_p2p.utils.errors import TransferTimeout
+
+Clock = Callable[[], int]  # monotonic nanoseconds
+
+
+def default_clock() -> Clock:
+    """Native monotonic clock when the C++ lib is built, else Python's."""
+    try:
+        from tpu_p2p.utils import native
+
+        if native.available():
+            return native.monotonic_ns
+    except Exception:  # pragma: no cover - defensive
+        pass
+    return time.perf_counter_ns
+
+
+@dataclass
+class Samples:
+    """Per-iteration timings plus the fenced-region total.
+
+    ``mean_region`` reproduces the reference's metric exactly:
+    total elapsed between the two barriers divided by iteration count
+    (``p2p_matrix.cc:174-176``). Percentiles come from the retained
+    per-iteration samples (our addition).
+    """
+
+    iter_seconds: list = field(default_factory=list)
+    region_seconds: float = 0.0
+    timed_out: bool = False
+
+    @property
+    def count(self) -> int:
+        return len(self.iter_seconds)
+
+    @property
+    def mean_region(self) -> float:
+        # p2p_matrix.cc:176 — elapsed / count
+        if self.timed_out or not self.count:
+            return math.nan
+        return self.region_seconds / self.count
+
+    @property
+    def mean(self) -> float:
+        if self.timed_out or not self.count:
+            return math.nan
+        return sum(self.iter_seconds) / self.count
+
+    def percentile(self, q: float) -> float:
+        """Nearest-rank percentile over per-iteration samples."""
+        if self.timed_out or not self.count:
+            return math.nan
+        try:
+            from tpu_p2p.utils import native
+
+            if native.available():
+                return native.percentile(self.iter_seconds, q)
+        except Exception:  # pragma: no cover
+            pass
+        s = sorted(self.iter_seconds)
+        rank = max(0, min(len(s) - 1, math.ceil(q / 100.0 * len(s)) - 1))
+        return s[rank]
+
+    @property
+    def p50(self) -> float:
+        return self.percentile(50.0)
+
+    @property
+    def p99(self) -> float:
+        return self.percentile(99.0)
+
+    @property
+    def min(self) -> float:
+        return min(self.iter_seconds) if self.iter_seconds else math.nan
+
+
+def gbps(nbytes: int, seconds: float, directions: int = 1) -> float:
+    """Throughput in Gbps — the reference formula, bit-for-bit.
+
+    ``msg_size * 8. / time / 1e9`` (``p2p_matrix.cc:177``), with
+    ``directions=2`` applying the bi-directional ``* 2``
+    (``p2p_matrix.cc:258``).
+    """
+    if seconds != seconds or seconds <= 0.0:  # NaN or degenerate
+        return math.nan
+    return nbytes * 8.0 / seconds / 1e9 * directions
+
+
+def _block(value, timeout_s: Optional[float]) -> None:
+    """``block_until_ready`` with an optional watchdog.
+
+    With no timeout this is exactly the ``cudaStreamSynchronize``
+    analogue. With one, a wedged transfer raises
+    :class:`TransferTimeout` rather than hanging the sweep (the
+    reference job would stall at ``MPI_Barrier`` until the launcher
+    killed it — SURVEY.md §5 failure detection).
+    """
+    if timeout_s is None:
+        jax.block_until_ready(value)
+        return
+    done = threading.Event()
+    err: list = []
+
+    def waiter():
+        try:
+            jax.block_until_ready(value)
+        except Exception as e:  # pragma: no cover - device failure path
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        raise TransferTimeout(f"transfer exceeded {timeout_s}s watchdog")
+    if err:
+        raise err[0]
+
+
+def measure_serialized(
+    fn: Callable,
+    x,
+    iters: int,
+    *,
+    warmup: int = 1,
+    clock: Optional[Clock] = None,
+    timeout_s: Optional[float] = None,
+    barrier: Optional[Callable[[], None]] = None,
+) -> Samples:
+    """Reference-semantics measurement: one message in flight, ever.
+
+    Reproduces the uni-directional hot loop's structure
+    (``p2p_matrix.cc:146-176``): barrier → start clock → ``iters`` ×
+    {dispatch; drain} → barrier → stop clock. The per-message drain
+    (``p2p_matrix.cc:162,170``) is ``block_until_ready`` on each call's
+    result, which also charges dispatch overhead to the measurement,
+    exactly as the reference charges launch overhead (SURVEY.md §3.3).
+    """
+    clock = clock or default_clock()
+    s = Samples()
+    try:
+        for _ in range(max(0, warmup)):
+            _block(fn(x), timeout_s)
+    except TransferTimeout:
+        # A pair that wedges on its very first (warm-up) transfer must
+        # still become a marked cell, not a crashed sweep.
+        s.timed_out = True
+        return s
+    if barrier is not None:
+        barrier()  # p2p_matrix.cc:146
+    t_region0 = clock()
+    try:
+        for _ in range(iters):
+            t0 = clock()
+            _block(fn(x), timeout_s)
+            s.iter_seconds.append((clock() - t0) / 1e9)
+    except TransferTimeout:
+        s.timed_out = True
+        return s
+    if barrier is not None:
+        barrier()  # p2p_matrix.cc:173
+    s.region_seconds = (clock() - t_region0) / 1e9
+    return s
+
+
+def measure_fused(
+    chain_fn: Callable,
+    x,
+    iters: int,
+    *,
+    repeats: int = 3,
+    warmup: int = 1,
+    clock: Optional[Clock] = None,
+    timeout_s: Optional[float] = None,
+    barrier: Optional[Callable[[], None]] = None,
+) -> Samples:
+    """Device-serialized measurement without host dispatch overhead.
+
+    ``chain_fn`` runs ``iters`` data-dependent hops inside one XLA
+    program (:meth:`CollectiveCache.permute_chain`); each timed sample
+    is one whole chain divided by ``iters``. This is the pipelined-peak
+    counterpart the reference cannot express (its per-iteration stream
+    sync forbids it — SURVEY.md §3.3 "key semantic"), labeled
+    separately so the two are never conflated (§7 hard part (c)).
+    """
+    clock = clock or default_clock()
+    s = Samples()
+    try:
+        for _ in range(max(0, warmup)):
+            _block(chain_fn(x), timeout_s)
+    except TransferTimeout:
+        s.timed_out = True
+        return s
+    if barrier is not None:
+        barrier()
+    t_region0 = clock()
+    try:
+        for _ in range(repeats):
+            t0 = clock()
+            _block(chain_fn(x), timeout_s)
+            per_iter = (clock() - t0) / 1e9 / iters
+            s.iter_seconds.append(per_iter)
+    except TransferTimeout:
+        s.timed_out = True
+        return s
+    if barrier is not None:
+        barrier()
+    # mean_region divides by len(iter_seconds) == repeats; pre-dividing the
+    # fenced elapsed by `iters` makes mean_region = elapsed/(repeats*iters),
+    # i.e. seconds per message, matching measure_serialized's units.
+    s.region_seconds = (clock() - t_region0) / 1e9 / iters
+    return s
