@@ -1,0 +1,72 @@
+"""CLI tests — parsing, config mapping, and the end-to-end entry."""
+
+import json
+
+import pytest
+
+from tpu_p2p.cli import build_parser, config_from_args, main
+
+
+def _cfg(argv):
+    return config_from_args(build_parser().parse_args(argv))
+
+
+def test_defaults_match_reference():
+    cfg = _cfg([])
+    assert cfg.msg_size == 32 * 1024 * 1024
+    assert cfg.iters == 128
+    assert cfg.dtype == "int8"
+    assert cfg.pattern == "pairwise" and cfg.direction == "both"
+
+
+def test_flag_mapping():
+    cfg = _cfg([
+        "--pattern", "ring", "--msg-size", "4KiB", "--iters", "7",
+        "--mode", "fused", "--isolation", "submesh", "--mesh-shape", "4x2",
+        "--sweep", "1KiB:4KiB", "--timeout", "2.5", "--check",
+        "--jsonl", "/tmp/x.jsonl", "--resume", "--num-devices", "4",
+    ])
+    assert cfg.pattern == "ring" and cfg.msg_size == 4096 and cfg.iters == 7
+    assert cfg.mode == "fused" and cfg.isolation == "submesh"
+    assert cfg.mesh_shape == (4, 2)
+    assert cfg.sweep == (1024, 2048, 4096)
+    assert cfg.timeout_s == 2.5 and cfg.check and cfg.resume
+    assert cfg.jsonl == "/tmp/x.jsonl" and cfg.num_devices == 4
+
+
+def test_bad_pattern_rejected_by_argparse(capsys):
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--pattern", "warp"])
+    assert "invalid choice" in capsys.readouterr().err
+
+
+def test_main_list_devices(capsys):
+    assert main(["--list-devices"]) == 0
+    out = capsys.readouterr().out
+    assert "8 devices on 1 host(s)" in out
+
+
+def test_main_end_to_end_pairwise(tmp_path, capsys):
+    jsonl = str(tmp_path / "out.jsonl")
+    rc = main([
+        "--pattern", "pairwise", "--direction", "uni", "--num-devices", "2",
+        "--msg-size", "4KiB", "--iters", "2", "--jsonl", jsonl, "--check",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Evaluating the Uni-Directional TPU P2P Bandwidth (Gbps)" in out
+    assert "# pairwise uni-dir 4KiB serialized" in out
+    recs = [json.loads(l) for l in open(jsonl)]
+    assert {(r["src"], r["dst"]) for r in recs} == {(0, 1), (1, 0)}
+
+
+def test_main_error_is_fail_fast(capsys):
+    rc = main(["--num-devices", "999"])
+    assert rc == 1
+    assert "Failed:" in capsys.readouterr().err
+
+
+def test_main_torus_without_2d_mesh_fails(capsys):
+    rc = main(["--pattern", "torus2d", "--iters", "1"])
+    assert rc == 1
+    assert "2-axis mesh" in capsys.readouterr().err

@@ -152,3 +152,18 @@ def test_out_of_range_edge_rejected(rt, cache):
     # from inside JAX (found during end-to-end verification).
     with pytest.raises(ValueError, match=r"edge \(0, 99\) out of range"):
         cache.permute(rt.mesh, "d", [(0, 99)])
+
+
+def test_loopback_chain_rewrites_buffer(rt):
+    cache = C.CollectiveCache()
+    x = C.make_payload(rt.mesh, 8192 * 4, jnp.int8)
+    fn = cache.loopback_chain(rt.mesh, 3)
+    y = _host(fn(x))
+    np.testing.assert_array_equal(y, (_host(x).astype(np.int32) + 3).astype(np.int8))
+
+
+def test_loopback_chain_non_tile_divisible(rt):
+    cache = C.CollectiveCache()
+    x = C.make_payload(rt.mesh, 100, jnp.int8)
+    y = _host(cache.loopback_chain(rt.mesh, 2)(x))
+    np.testing.assert_array_equal(y, (_host(x).astype(np.int32) + 2).astype(np.int8))

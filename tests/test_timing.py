@@ -122,3 +122,67 @@ def test_default_clock_monotonic():
     c = timing.default_clock()
     a, b = c(), c()
     assert b >= a
+
+
+def test_measure_differential_slope():
+    # Chain(k) costs base 50ms + k*2ms with the fake clock contributing
+    # one read per fence; model with a scripted clock.
+    class SlopeClock:
+        def __init__(self):
+            self.t = 0
+            self.pending = 0
+
+        def __call__(self):
+            self.t += self.pending
+            self.pending = 0
+            self.t += 1  # 1 ns per read
+            return self.t
+
+    clock = SlopeClock()
+
+    def make_chain(k):
+        def fn(x):
+            clock.pending += 50_000_000 + k * 2_000_000  # 50ms + 2ms/op
+            return x
+
+        return fn
+
+    s = timing.measure_differential(
+        make_chain, 0, iters=32, repeats=3, clock=clock, fence=lambda y: None
+    )
+    # slope = 2 ms/op regardless of the 50 ms constant cost
+    assert s.mean_region == pytest.approx(2e-3, rel=1e-3)
+
+
+def test_measure_differential_negative_slope_clamped():
+    # A chain whose "long" run comes back faster than the "short" one
+    # (pure noise) must yield NaN-able zero, not a negative bandwidth.
+    class ShrinkingClock:
+        def __init__(self):
+            self.t = 0
+            self.costs = iter([50, 50, 60, 40, 60, 40, 60, 40])  # ms pairs
+
+        def __call__(self):
+            self.t += next(self.costs, 10) * 1_000_000
+            return self.t
+
+    s = timing.measure_differential(
+        lambda k: (lambda x: x), 0, iters=16, repeats=3,
+        clock=ShrinkingClock(), fence=lambda y: None,
+    )
+    assert s.region_seconds == 0.0
+    assert s.mean_region == 0.0
+    import math
+    assert math.isnan(timing.gbps(1024, s.mean_region))
+
+
+def test_measure_differential_timeout_marks_cell():
+    def hanging_fence(y):
+        import threading
+        threading.Event().wait(10)
+
+    s = timing.measure_differential(
+        lambda k: (lambda x: x), 0, iters=8, repeats=2,
+        fence=hanging_fence, timeout_s=0.05,
+    )
+    assert s.timed_out

@@ -1,0 +1,167 @@
+"""L5 workload tests on the simulated 8-device mesh."""
+
+import io
+import json
+import math
+
+import pytest
+
+from tpu_p2p.config import BenchConfig
+from tpu_p2p.utils.report import JsonlWriter, load_done_cells
+from tpu_p2p.workloads import WORKLOADS
+from tpu_p2p.workloads.base import WorkloadContext
+from tpu_p2p.workloads.pairwise import run_pairwise
+from tpu_p2p.workloads.ring import run_ring
+from tpu_p2p.workloads.alltoall import run_all_to_all
+from tpu_p2p.workloads.latency import run_latency, run_loopback
+from tpu_p2p.workloads.torus import run_torus2d
+from tpu_p2p.utils.errors import BackendError
+
+
+def _ctx(rt, tmp_path=None, **kw):
+    jsonl = None
+    done = {}
+    cfg = BenchConfig(**{**dict(msg_size=4096, iters=2, warmup=1), **kw})
+    if cfg.num_devices is not None:
+        # mirror the CLI: a num_devices limit rebuilds the runtime
+        from tpu_p2p.parallel.runtime import make_runtime
+
+        rt = make_runtime(num_devices=cfg.num_devices)
+    if tmp_path is not None:
+        cfg = cfg.replace(jsonl=str(tmp_path / "cells.jsonl"))
+        jsonl = JsonlWriter(cfg.jsonl)
+        if cfg.resume:
+            done = load_done_cells(cfg.jsonl)
+    return WorkloadContext(rt=rt, cfg=cfg, jsonl=jsonl, done=done)
+
+
+def test_registry_has_all_runnable_patterns():
+    for name in ("pairwise", "ring", "all_to_all", "torus2d", "latency", "loopback"):
+        assert name in WORKLOADS, name
+
+
+def test_pairwise_uni_produces_full_matrix(rt, capsys):
+    ctx = _ctx(rt, direction="uni", check=True)
+    results = run_pairwise(ctx)
+    out = capsys.readouterr().out
+    assert "Evaluating the Uni-Directional TPU P2P Bandwidth (Gbps)" in out
+    assert out.count("\n") >= 9  # header + 8 rows
+    (res,) = results
+    assert res["cells"] == 56 and res["min"] > 0
+
+
+def test_pairwise_bi_doubles_accounting(rt, tmp_path):
+    # Bi-dir must apply the ×2 of p2p_matrix.cc:258: the recorded Gbps
+    # equals the reference formula over mean_region time, doubled.
+    ctx = _ctx(rt, tmp_path, direction="bi", num_devices=2)
+    run_pairwise(ctx)
+    ctx.jsonl.close()
+    recs = [json.loads(l) for l in open(ctx.cfg.jsonl)]
+    assert len(recs) == 2
+    for rec in recs:
+        assert rec["direction"] == "bi" and rec["gbps"] > 0
+
+
+def test_pairwise_submesh_isolation(rt, capsys):
+    ctx = _ctx(rt, direction="uni", isolation="submesh", num_devices=3, check=True)
+    run_pairwise(ctx)
+    out = capsys.readouterr().out
+    assert "# pairwise uni-dir" in out
+
+
+def test_pairwise_sweep_runs_each_size(rt, capsys):
+    ctx = _ctx(rt, direction="uni", sweep=(1024, 2048))
+    results = run_pairwise(ctx)
+    assert [r["msg_bytes"] for r in results] == [1024, 2048]
+    out = capsys.readouterr().out
+    assert "1KiB" in out and "2KiB" in out
+
+
+def test_pairwise_jsonl_and_resume(rt, tmp_path, capsys):
+    ctx = _ctx(rt, tmp_path, direction="uni", num_devices=2)
+    run_pairwise(ctx)
+    ctx.jsonl.close()
+    lines = [json.loads(l) for l in open(ctx.cfg.jsonl)]
+    assert len(lines) == 2  # (0,1) and (1,0)
+    assert {(l["src"], l["dst"]) for l in lines} == {(0, 1), (1, 0)}
+    # Resume: previously-done cells replayed, no new JSONL writes.
+    ctx2 = _ctx(rt, tmp_path, direction="uni", num_devices=2, resume=True)
+    assert len(ctx2.done) == 2
+    run_pairwise(ctx2)
+    ctx2.jsonl.close()
+    assert len(open(ctx2.cfg.jsonl).readlines()) == 2  # unchanged
+
+
+def test_ring_workload(rt, capsys):
+    ctx = _ctx(rt, pattern="ring", check=True)
+    (res,) = run_ring(ctx)
+    assert res["gbps_per_device"] > 0
+    assert "ring shift-by-1" in capsys.readouterr().out
+
+
+def test_all_to_all_workload(rt, capsys):
+    ctx = _ctx(rt, pattern="all_to_all", msg_size=8 * 512, check=True)
+    (res,) = run_all_to_all(ctx)
+    assert res["gbps_per_device_tx"] > 0
+    assert "all_to_all" in capsys.readouterr().out
+
+
+def test_all_to_all_rejects_indivisible_size(rt):
+    ctx = _ctx(rt, pattern="all_to_all", msg_size=1001)
+    with pytest.raises(BackendError, match="divisible"):
+        run_all_to_all(ctx)
+
+
+def test_latency_workload_reports_percentiles(rt, capsys):
+    ctx = _ctx(rt, pattern="latency", iters=4, msg_size=32 * 1024 * 1024)
+    res = run_latency(ctx)
+    assert res["bytes"] == 8  # default 32MiB swaps to the 8B metric size
+    assert res["p50_us"] > 0 and res["p99_us"] >= res["p50_us"]
+    assert "dispatch-inclusive" in capsys.readouterr().out
+
+
+def test_loopback_picks_intra_host_pair(rt, capsys):
+    ctx = _ctx(rt, pattern="loopback", iters=4)
+    res = run_loopback(ctx)
+    assert res["bytes"] == 4096
+    assert res["dst"] == 1  # 8 devices all on host 0 → pair (0,1)
+    assert "loopback" in capsys.readouterr().out
+
+
+def test_torus2d_measures_both_axes(rt2d, capsys):
+    ctx = _ctx(rt2d, pattern="torus2d", check=True)
+    results = run_torus2d(ctx)
+    assert {r["axis"] for r in results} == {"x", "y"}
+    out = capsys.readouterr().out
+    assert "axis 'x' (size 4)" in out and "axis 'y' (size 2)" in out
+
+
+def test_torus2d_requires_2d_mesh(rt):
+    ctx = _ctx(rt, pattern="torus2d")
+    with pytest.raises(BackendError, match="2-axis mesh"):
+        run_torus2d(ctx)
+
+
+def test_fused_mode_pairwise(rt, capsys):
+    ctx = _ctx(rt, direction="uni", mode="fused", num_devices=2)
+    run_pairwise(ctx)
+    assert "fused" in capsys.readouterr().out
+
+
+def test_ring_attention_workload(rt, capsys):
+    from tpu_p2p.models.ring_transformer import ModelConfig
+    from tpu_p2p.workloads.ring_attn import run_ring_attention
+
+    ctx = _ctx(rt, iters=2)
+    mc = ModelConfig(batch=2, seq=64, heads=2, head_dim=8, dtype="float32")
+    res = run_ring_attention(ctx, mc)
+    assert res["devices"] == 8 and res["p50_ms"] > 0
+    out = capsys.readouterr().out
+    assert "ring_attention" in out and "TFLOP/s" in out
+
+
+def test_differential_mode_pairwise(rt, capsys):
+    ctx = _ctx(rt, direction="uni", mode="differential", num_devices=2, iters=16)
+    run_pairwise(ctx)
+    out = capsys.readouterr().out
+    assert "# pairwise uni-dir 4KiB differential" in out

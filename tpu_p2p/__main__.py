@@ -1,0 +1,9 @@
+"""``python -m tpu_p2p`` — the ``p2p_matrix`` binary's entry point
+(reference launch contract: ``/root/reference/README.md:5``)."""
+
+import sys
+
+from tpu_p2p.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,189 @@
+"""CLI — L0'/config entry point (SURVEY.md §7 item 6).
+
+The reference's launch contract is ``mpirun -n NUM_PROCESS p2p_matrix``
+with zero program flags (``/root/reference/README.md:5``;
+``p2p_matrix.cc:105`` passes argv only to MPI). On TPU the launcher
+disappears — JAX enumerates the slice's devices itself — and the
+BASELINE.json configs (size sweeps, patterns, mesh axes) require real
+flags, with defaults reproducing the reference's constants
+(32 MiB / 128 iters / int8 — ``p2p_matrix.cc:124,132,158``).
+
+Run: ``python -m tpu_p2p [flags]`` or ``make run ARGS="..."``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Sequence
+
+from tpu_p2p.config import (
+    BenchConfig,
+    DIRECTIONS,
+    ISOLATIONS,
+    MODES,
+    PATTERNS,
+    parse_size,
+    parse_sweep,
+)
+from tpu_p2p.utils.errors import fail_fast
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu_p2p",
+        description=(
+            "TPU-native interconnect microbenchmarks: all-pairs P2P "
+            "bandwidth matrices (the reference workload), ring / "
+            "all_to_all / 2D-torus collectives, small-message latency, "
+            "and a ring-attention transport workload."
+        ),
+    )
+    p.add_argument("--pattern", choices=PATTERNS, default="pairwise",
+                   help="workload to run (default: the reference's all-pairs matrix)")
+    p.add_argument("--msg-size", default="32MiB", metavar="SIZE",
+                   help="payload per message, e.g. 4KiB, 32MiB, 1GiB (reference: 32MiB)")
+    p.add_argument("--sweep", default=None, metavar="LO:HI|A,B,...",
+                   help="message-size sweep: power-of-two range '1KiB:1GiB' or explicit list")
+    p.add_argument("--iters", type=int, default=128,
+                   help="messages per measured cell (reference: 128)")
+    p.add_argument("--warmup", type=int, default=1,
+                   help="untimed warm-up calls per cell; excludes XLA compile (reference: 0)")
+    p.add_argument("--dtype", default="int8", help="payload dtype (reference: int8)")
+    p.add_argument("--direction", choices=DIRECTIONS, default="both",
+                   help="pairwise sweeps to run (reference runs uni then bi)")
+    p.add_argument("--mode", choices=MODES, default="serialized",
+                   help="serialized = one message in flight (reference semantics); "
+                        "fused = device-chained hops, no host dispatch")
+    p.add_argument("--isolation", choices=ISOLATIONS, default="full",
+                   help="full = one N-device program per pair; submesh = 2-device mesh per pair")
+    p.add_argument("--num-devices", type=int, default=None,
+                   help="use only the first N devices")
+    p.add_argument("--mesh-shape", default=None, metavar="AxB",
+                   help="2D mesh, e.g. 4x2 (required for torus2d)")
+    p.add_argument("--fused-repeats", type=int, default=3,
+                   help="timed chain executions in fused mode")
+    p.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
+                   help="per-transfer watchdog; wedged cells report NaN instead of hanging")
+    p.add_argument("--check", action="store_true",
+                   help="verify payload contents after transfer (rank-tagged patterns)")
+    p.add_argument("--jsonl", default=None, metavar="PATH",
+                   help="append per-cell JSONL records (machine-readable twin of the matrix)")
+    p.add_argument("--resume", action="store_true",
+                   help="skip cells already recorded in --jsonl")
+    p.add_argument("--profile-dir", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the run")
+    p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
+                   help="testing: force CPU platform with N simulated devices")
+    p.add_argument("--list-devices", action="store_true",
+                   help="print the validated device/topology table and exit")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> BenchConfig:
+    mesh_shape = None
+    if args.mesh_shape:
+        try:
+            mesh_shape = tuple(int(d) for d in args.mesh_shape.lower().split("x"))
+        except ValueError:
+            raise SystemExit(
+                f"--mesh-shape must look like 4x2, got {args.mesh_shape!r}"
+            )
+    return BenchConfig(
+        pattern=args.pattern,
+        msg_size=parse_size(args.msg_size),
+        iters=args.iters,
+        warmup=args.warmup,
+        dtype=args.dtype,
+        direction=args.direction,
+        mode=args.mode,
+        isolation=args.isolation,
+        num_devices=args.num_devices,
+        mesh_shape=mesh_shape,
+        sweep=parse_sweep(args.sweep) if args.sweep else None,
+        fused_repeats=args.fused_repeats,
+        timeout_s=args.timeout,
+        check=args.check,
+        jsonl=args.jsonl,
+        resume=args.resume,
+        profile_dir=args.profile_dir,
+    )
+
+
+def _force_cpu_mesh(n: int) -> None:
+    """Testing backdoor: N simulated devices on the host platform.
+
+    Note: this process's sitecustomize may already have imported jax
+    with a TPU plugin bound, so the env-var route alone is not enough —
+    the config update must run before any backend instantiation.
+    """
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={n}"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _print_devices(rt) -> None:
+    print(f"{rt.num_devices} devices on {rt.placement.num_hosts} host(s), "
+          f"{rt.placement.devices_per_host} per host; mesh axes "
+          f"{dict(zip(rt.mesh.axis_names, rt.mesh.devices.shape))}")
+    for i, d in enumerate(rt.devices):
+        coords = getattr(d, "coords", None)
+        extra = f" coords={coords}" if coords is not None else ""
+        print(f"  [{i}] {d.device_kind} host={rt.placement.host_of[i]} "
+              f"local={rt.placement.local_ids[i]}{extra}")
+    if rt.torus is not None:
+        print(f"  torus dims: {rt.torus.dims}")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.cpu_mesh:
+            _force_cpu_mesh(args.cpu_mesh)
+        cfg = config_from_args(args)
+
+        # Imports deferred past _force_cpu_mesh so the platform switch
+        # precedes backend instantiation.
+        from tpu_p2p.parallel.runtime import make_runtime
+        from tpu_p2p.utils.report import JsonlWriter, load_done_cells
+        from tpu_p2p.workloads import WORKLOADS  # registers all patterns
+
+        rt = make_runtime(num_devices=cfg.num_devices, mesh_shape=cfg.mesh_shape)
+        if args.list_devices:
+            _print_devices(rt)
+            return 0
+        run = WORKLOADS.get(cfg.pattern)
+        if run is None:
+            raise SystemExit(f"pattern {cfg.pattern!r} is not implemented yet")
+
+        from tpu_p2p.workloads.base import WorkloadContext
+
+        ctx = WorkloadContext(
+            rt=rt,
+            cfg=cfg,
+            jsonl=JsonlWriter(cfg.jsonl) if cfg.jsonl else None,
+            done=load_done_cells(cfg.jsonl) if cfg.resume else {},
+        )
+        try:
+            if cfg.profile_dir:
+                import jax
+
+                with jax.profiler.trace(cfg.profile_dir):
+                    run(ctx)
+            else:
+                run(ctx)
+        finally:
+            if ctx.jsonl is not None:
+                ctx.jsonl.close()
+        return 0
+    except KeyboardInterrupt:
+        print("interrupted", file=sys.stderr)
+        return 130
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 — single fail-fast handler (L8)
+        return fail_fast(e)

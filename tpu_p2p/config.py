@@ -71,7 +71,9 @@ PATTERNS = (
     "ring_attention",  # flagship SP workload over the same transport
 )
 
-MODES = ("serialized", "fused")  # SURVEY.md §7 hard part (c)
+MODES = ("serialized", "fused", "differential")  # SURVEY.md §7 hard part (c);
+# differential = two-chain-length slope, cancels all constant per-call
+# overhead (the only trustworthy mode on relayed PJRT platforms)
 ISOLATIONS = ("full", "submesh")  # SURVEY.md §7 hard part (a)
 DIRECTIONS = ("uni", "bi", "both")
 

@@ -1,0 +1,206 @@
+"""RingTransformer — the framework's flagship model workload.
+
+A deliberately small transformer block whose *sharding* is the point:
+it exercises, in one jitted training step, every parallelism axis the
+framework's transport layer measures (SURVEY.md §2.3):
+
+- **dp** (data): batch sharded; gradient all-reduce (``psum``) over
+  ``dp``.
+- **sp** (sequence): sequence sharded; ring attention rotates KV via
+  shift-by-1 ``ppermute`` — the ``ring`` workload's transport
+  (BASELINE.json configs[2]).
+- **tp** (tensor): attention heads sharded Megatron-style; the output
+  projection's partial sums join via ``psum`` over ``tp``.
+
+The reference has no model (SURVEY.md §2.3 — "no model math exists");
+this module exists because a TPU framework for interconnect workloads
+must also demonstrate the *composite* pattern a real long-context
+training step produces, not just isolated collectives. It is also the
+compile target for ``__graft_entry__.entry`` / ``dryrun_multichip``.
+
+Pure JAX (no flax dependency): params are a pytree dict; the training
+step is ``jax.value_and_grad`` + SGD inside one ``shard_map``.
+
+Gradient correctness under sharding (worth spelling out): shard_map's
+autodiff + replication typing does all gradient reductions itself —
+cotangents of inputs replicated over an axis arrive already psum-ed
+over that axis, and the loss computed redundantly across tp ranks
+(after ``psum(y, tp)``) is typed replicated, counting as one loss.
+The training step therefore contains no explicit gradient collectives
+at all; adding them double-counts. tests/test_model.py pins every mesh
+shape against a single-device oracle.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_p2p.ops.attention import dense_attention, ring_attention_local
+
+Params = Dict[str, jax.Array]
+
+HEAD_PARAMS = ("wq", "wk", "wv", "wo")  # [H, ...] arrays, tp-shardable
+MLP_PARAMS = ("w1", "w2")  # replicated everywhere
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Global shapes; defaults keep the MXU busy at bf16 tiles."""
+
+    batch: int = 8
+    seq: int = 512
+    heads: int = 8
+    head_dim: int = 64
+    mlp_mult: int = 4
+    causal: bool = True
+    dtype: str = "bfloat16"
+
+    @property
+    def model_dim(self) -> int:
+        return self.heads * self.head_dim
+
+    def tiny(self, mesh: Mesh) -> "ModelConfig":
+        """Shrink to dryrun scale while keeping every axis shardable."""
+        axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        return replace(
+            self,
+            batch=2 * axes.get("dp", 1),
+            seq=16 * axes.get("sp", 1),
+            heads=max(2, axes.get("tp", 1)) * axes.get("tp", 1),
+            head_dim=8,
+            mlp_mult=2,
+        )
+
+
+def init_params(cfg: ModelConfig, seed: int = 0) -> Params:
+    rng = np.random.default_rng(seed)
+    dm, dh, nh = cfg.model_dim, cfg.head_dim, cfg.heads
+    dtype = jnp.dtype(cfg.dtype)
+
+    def w(*shape):
+        fan_in = shape[-2] if len(shape) > 1 else shape[0]
+        return jnp.asarray(
+            rng.standard_normal(shape) / math.sqrt(fan_in), dtype=dtype
+        )
+
+    return {
+        "wq": w(nh, dm, dh),
+        "wk": w(nh, dm, dh),
+        "wv": w(nh, dm, dh),
+        "wo": w(nh, dh, dm),
+        "w1": w(dm, cfg.mlp_mult * dm),
+        "w2": w(cfg.mlp_mult * dm, dm),
+    }
+
+
+def _axis(mesh: Mesh, name: str):
+    return name if name in mesh.axis_names else None
+
+
+def param_specs(mesh: Mesh) -> Dict[str, P]:
+    tp = _axis(mesh, "tp")
+    specs = {k: P(tp, None, None) for k in HEAD_PARAMS}
+    specs.update({k: P(None, None) for k in MLP_PARAMS})
+    return specs
+
+
+def data_spec(mesh: Mesh) -> P:
+    return P(_axis(mesh, "dp"), _axis(mesh, "sp"), None)
+
+
+def _forward(params, x, cfg: ModelConfig, sp, tp):
+    """Local-shard forward. x: [B_loc, T_loc, Dm]; head params hold
+    this tp rank's head slice."""
+    q = jnp.einsum("btm,hmd->bhtd", x, params["wq"])
+    k = jnp.einsum("btm,hmd->bhtd", x, params["wk"])
+    v = jnp.einsum("btm,hmd->bhtd", x, params["wv"])
+    if sp is not None:
+        a = ring_attention_local(q, k, v, sp, causal=cfg.causal)
+    else:
+        a = dense_attention(q, k, v, causal=cfg.causal)
+    y = jnp.einsum("bhtd,hdm->btm", a, params["wo"])
+    if tp is not None:
+        y = jax.lax.psum(y, tp)  # Megatron join of head shards
+    h = jax.nn.gelu(jnp.einsum("btm,mf->btf", x + y, params["w1"]))
+    return x + y + jnp.einsum("btf,fm->btm", h, params["w2"])
+
+
+def make_forward(mesh: Mesh, cfg: ModelConfig):
+    """Jitted forward over the mesh (``__graft_entry__.entry`` target)."""
+    sp, tp = _axis(mesh, "sp"), _axis(mesh, "tp")
+
+    def f(params, x):
+        return _forward(params, x, cfg, sp, tp)
+
+    sm = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(param_specs(mesh), data_spec(mesh)),
+        out_specs=data_spec(mesh),
+    )
+    return jax.jit(sm)
+
+
+def make_train_step(mesh: Mesh, cfg: ModelConfig, lr: float = 1e-3):
+    """One jitted SGD step over a (dp, sp, tp) mesh — forward, backward,
+    gradient all-reduce, parameter update. See module docstring for the
+    tp gradient accounting."""
+    dp, sp, tp = _axis(mesh, "dp"), _axis(mesh, "sp"), _axis(mesh, "tp")
+    n_out = cfg.batch * cfg.seq * cfg.model_dim  # global normalizer
+
+    def step(params, x, target):
+        def local_loss(p):
+            out = _forward(p, x, cfg, sp, tp)
+            return jnp.sum(
+                (out.astype(jnp.float32) - target.astype(jnp.float32)) ** 2
+            )
+
+        loss, grads = jax.value_and_grad(local_loss)(params)
+        # shard_map autodiff handles every reduction itself: cotangents
+        # of inputs replicated over an axis are psum-ed over that axis
+        # (dp/sp for all params, tp for the MLP params), and the
+        # replication typing of the post-psum(y, tp) loss means the
+        # redundant tp copies count as ONE loss, not tp losses. Adding
+        # explicit grad psums here would double-count — verified
+        # against a single-device oracle in tests/test_model.py.
+        dpsp = tuple(a for a in (dp, sp) if a is not None)
+        if dpsp:
+            loss = jax.lax.psum(loss, dpsp)
+        new_params = jax.tree.map(
+            lambda p, g: (p.astype(jnp.float32) - lr * g / n_out).astype(p.dtype),
+            params, grads,
+        )
+        return new_params, loss / n_out
+
+    sm = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(param_specs(mesh), data_spec(mesh), data_spec(mesh)),
+        out_specs=(param_specs(mesh), P()),
+    )
+    return jax.jit(sm)
+
+
+def place_params(params: Params, mesh: Mesh) -> Params:
+    specs = param_specs(mesh)
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in params.items()
+    }
+
+
+def example_batch(cfg: ModelConfig, mesh: Mesh = None, seed: int = 1) -> Tuple:
+    rng = np.random.default_rng(seed)
+    dtype = jnp.dtype(cfg.dtype)
+    shape = (cfg.batch, cfg.seq, cfg.model_dim)
+    x = jnp.asarray(rng.standard_normal(shape), dtype=dtype)
+    t = jnp.asarray(rng.standard_normal(shape), dtype=dtype)
+    if mesh is not None:
+        sharding = NamedSharding(mesh, data_spec(mesh))
+        x, t = jax.device_put(x, sharding), jax.device_put(t, sharding)
+    return x, t

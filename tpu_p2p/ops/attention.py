@@ -1,0 +1,159 @@
+"""Ring attention — sequence-parallel attention over the benchmark's
+own transport.
+
+The reference has no model code (SURVEY.md §2.3: no attention or
+sequence dimension exists in ``p2p_matrix.cc``), but its subject — the
+neighbor-shift transfer pattern — is exactly the transport ring
+attention is built on (SURVEY.md §5 "long-context / sequence
+parallelism": ring-CP = shift-by-1 ``ppermute``, the
+``ring`` workload / BASELINE.json configs[2]). This module supplies the
+compute side so the framework can measure the *overlapped*
+communication+compute behavior of a real sequence-parallel workload,
+not just raw link speed.
+
+Design (TPU-first, not a port of any CUDA kernel):
+
+- Sequence dim sharded over a mesh axis; each device holds a
+  ``[B, H, T/n, D]`` block of Q, K, V.
+- Blockwise-streaming softmax (the log-sum-exp accumulation of online
+  softmax): process the local KV block, then ``n-1`` ring hops, each
+  rotating the KV block right via ``ppermute`` while accumulating
+  ``(o, m, l)`` in float32 — numerically identical to full softmax.
+- Everything is ``lax.scan``/``jnp.where`` — static shapes, no
+  data-dependent control flow, MXU-shaped einsums in bfloat16 with
+  float32 accumulation.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps XLA happy on
+# fully-masked rows (no NaN from (-inf) - (-inf))
+
+
+def dense_attention(q, k, v, *, causal: bool = False):
+    """Reference single-device attention (test oracle)."""
+    b, h, t, d = q.shape
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s / math.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _block_scores(q, k, scale):
+    return jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def _merge(o, m, l, s, v):
+    """Fold one block's scores/values into the (o, m, l) accumulator.
+
+    Standard streaming-softmax update: rescale the running numerator by
+    ``exp(m - m_new)`` and add the new block's contribution.
+    """
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return o_new, m_new, l_new
+
+
+def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False):
+    """Per-shard ring attention body — call inside ``shard_map``.
+
+    ``q, k, v``: local blocks ``[B, H, T_local, D]``, sequence sharded
+    along ``axis_name``. KV blocks rotate right around the ring
+    (edge set ``ring_edges(n)``, the ``ring`` workload's transport)
+    while each device accumulates attention of its queries over every
+    block — ``n - 1`` ``ppermute`` hops overlapped with compute.
+    """
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, h, t, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    edges = [(i, (i + 1) % n) for i in range(n)]
+
+    o = jnp.zeros((b, h, t, d), jnp.float32)
+    m = jnp.full((b, h, t), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, t), jnp.float32)
+
+    q_pos = my * t + jnp.arange(t)  # global query positions
+
+    def block_mask(s, src_block):
+        if not causal:
+            return s
+        k_pos = src_block * t + jnp.arange(t)
+        visible = q_pos[:, None] >= k_pos[None, :]
+        return jnp.where(visible[None, None], s, NEG_INF)
+
+    # Local block first (no hop needed)…
+    s0 = block_mask(_block_scores(q, k, scale), my)
+    o, m, l = _merge(o, m, l, s0, v)
+
+    # …then n-1 rotate-and-accumulate hops.
+    def hop(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, edges)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, edges)
+        src = jax.lax.rem(my - i - 1 + n + n, n)  # block now held locally
+        s = block_mask(_block_scores(q, k_nxt, scale), src)
+        o2, m2, l2 = _merge(o, m, l, s, v_nxt)
+        return (o2, m2, l2, k_nxt, v_nxt), None
+
+    if n > 1:
+        (o, m, l, _, _), _ = jax.lax.scan(
+            hop, (o, m, l, k, v), jnp.arange(n - 1)
+        )
+
+    # Fully-masked rows (can't happen for causal ring queries, but keep
+    # the kernel total): guard l == 0.
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def ring_attention(mesh: Mesh, axis: str, causal: bool = False):
+    """Jitted global ring attention over ``mesh``.
+
+    Takes global ``[B, H, T, D]`` arrays with ``T`` sharded along
+    ``axis`` (other mesh axes unused here — the model layer in
+    :mod:`tpu_p2p.models.ring_transformer` composes dp/tp on top).
+    """
+    spec = P(None, None, axis, None)
+
+    def f(q, k, v):
+        return ring_attention_local(q, k, v, axis, causal=causal)
+
+    return jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    )
+
+
+def attention_sharding(mesh: Mesh, axis: str) -> NamedSharding:
+    return NamedSharding(mesh, P(None, None, axis, None))
+
+
+def flops_per_step(b: int, h: int, t: int, d: int, *, causal: bool = False) -> int:
+    """Attention FLOPs for one forward: 2·(QK) + 2·(PV) matmuls."""
+    total = 4 * b * h * t * t * d
+    return total // 2 if causal else total
+
+
+def kv_bytes_per_hop(b: int, h: int, t_local: int, d: int, dtype) -> int:
+    """Bytes each device ships per ring hop (K and V blocks)."""
+    return 2 * b * h * t_local * d * jnp.dtype(dtype).itemsize

@@ -184,6 +184,40 @@ class CollectiveCache:
 
         return self._get(key, build)
 
+    def loopback_chain(self, mesh: Mesh, count: int):
+        """``count`` chained whole-buffer rewrites on each device.
+
+        The loopback config (BASELINE configs[0]) degenerates on a
+        single chip: a self-edge ``ppermute`` is an identity XLA
+        deletes entirely (measured: an "infinite-bandwidth" no-op). A
+        per-hop ``x + 1`` cannot be elided and streams the full buffer
+        through HBM once per hop — the honest on-device analogue of a
+        loopback transfer (read ``msg`` + write ``msg`` per hop).
+        """
+        key = ("loopback", mesh, count)
+
+        def build():
+            spec = P(*mesh.axis_names, None)
+
+            def f(x):
+                # The payload's local block is (1, ..., elems); int8
+                # tiling pads a 1-row shape badly (measured 3.9x slower
+                # per rewrite), so stream through a (rows, 8192) view.
+                shape = x.shape
+                y = x.reshape(-1, 8192) if x.size % 8192 == 0 else x
+
+                def step(carry, _):
+                    return carry + jnp.ones((), carry.dtype), None
+
+                out, _ = jax.lax.scan(step, y, None, length=count)
+                return out.reshape(shape)
+
+            return jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+            )
+
+        return self._get(key, build)
+
     # -- all-to-all ------------------------------------------------------
 
     def all_to_all(self, mesh: Mesh, axis: str):

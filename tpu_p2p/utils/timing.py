@@ -33,6 +33,7 @@ instead of the reference's behavior of hanging the job at the next
 from __future__ import annotations
 
 import math
+import statistics
 import threading
 import time
 from dataclasses import dataclass, field
@@ -202,6 +203,143 @@ def measure_serialized(
     if barrier is not None:
         barrier()  # p2p_matrix.cc:173
     s.region_seconds = (clock() - t_region0) / 1e9
+    return s
+
+
+def readback_fence(value) -> None:
+    """Completion fence via a 1-element device→host readback.
+
+    ``block_until_ready`` is the normal ``cudaStreamSynchronize``
+    analogue, but on relayed/remote PJRT platforms (e.g. the axon TPU
+    tunnel in this dev environment) it can return on *enqueue-ack*
+    rather than completion — measured here as a v5e "achieving" 32
+    PFLOP/s. Fetching one element of the result cannot complete before
+    the computation has, on any platform.
+
+    Multi-process arrays are not fully addressable; there, read back an
+    element of this process's first local shard instead (fences local
+    completion; cross-host alignment is the caller's barrier's job).
+    """
+    leaf = jax.tree_util.tree_leaves(value)[0]
+    if getattr(leaf, "is_fully_addressable", True):
+        jax.device_get(leaf.ravel()[0])
+    else:
+        shard = leaf.addressable_shards[0].data
+        jax.device_get(shard.ravel()[0])
+
+
+_fence_trust: Optional[bool] = None
+
+
+def block_fence_is_trustworthy(refresh: bool = False) -> bool:
+    """Does ``block_until_ready`` actually wait for completion here?
+
+    Times a fixed compute chain under both fences; if the block fence
+    claims to finish in under half the readback-fenced time, it is not
+    waiting. Cached after first call.
+    """
+    global _fence_trust
+    if _fence_trust is not None and not refresh:
+        return _fence_trust
+    import jax.numpy as jnp
+
+    # One big single op (no chain): several ms of real device time. A
+    # lying fence returns in tens of microseconds; an honest one takes
+    # at least a large fraction of the readback-fenced time. The
+    # readback includes host-transfer overhead, so on honest-but-slow
+    # tunnels this check may conservatively report False — and the
+    # differential fallback is correct there anyway.
+    k = 4096
+    a = jnp.ones((k, k), jnp.bfloat16)
+    f = jax.jit(lambda x: x @ x)
+    readback_fence(f(a))  # compile + warm
+    t0 = time.perf_counter_ns()
+    jax.block_until_ready(f(a))
+    t_block = time.perf_counter_ns() - t0
+    t0 = time.perf_counter_ns()
+    readback_fence(f(a))
+    t_read = time.perf_counter_ns() - t0
+    _fence_trust = t_block >= 0.3 * t_read
+    return _fence_trust
+
+
+def measure_differential(
+    make_chain: Callable[[int], Callable],
+    x,
+    iters: int,
+    *,
+    repeats: int = 3,
+    clock: Optional[Clock] = None,
+    fence: Callable = readback_fence,
+    timeout_s: Optional[float] = None,
+    barrier: Optional[Callable[[], None]] = None,
+) -> Samples:
+    """Per-message time as the slope between two chain lengths.
+
+    ``time(chain(iters)) - time(chain(short))`` divided by
+    ``iters - short`` cancels *every* constant per-call cost — host
+    dispatch, relay/tunnel round-trips, fence overhead — leaving pure
+    device-side per-hop time. This is the only honest bandwidth
+    measurement on platforms where the block fence is untrustworthy
+    (see :func:`readback_fence`), and a useful dispatch-free metric
+    everywhere (SURVEY.md §7 hard parts (b)/(e)).
+    """
+    clock = clock or default_clock()
+    short = max(1, iters // 8)
+    if short >= iters:
+        iters = short + 1
+    f_short, f_long = make_chain(short), make_chain(iters)
+
+    def fenced(value):
+        # Same watchdog contract as _block: a wedged link becomes a
+        # marked cell, not a hung sweep.
+        if timeout_s is None:
+            fence(value)
+            return
+        done = threading.Event()
+        err: list = []
+
+        def waiter():
+            try:
+                fence(value)
+            except Exception as e:  # pragma: no cover - device failure
+                err.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        if not done.wait(timeout_s):
+            raise TransferTimeout(f"transfer exceeded {timeout_s}s watchdog")
+        if err:
+            raise err[0]
+
+    s = Samples()
+    try:
+        fenced(f_short(x))  # compile + warm
+        fenced(f_long(x))
+        if barrier is not None:
+            barrier()
+        for _ in range(repeats):
+            t0 = clock()
+            fenced(f_short(x))
+            t_short = (clock() - t0) / 1e9
+            t0 = clock()
+            fenced(f_long(x))
+            t_long = (clock() - t0) / 1e9
+            # Raw slope, unclamped: noise can make a sample negative
+            # when per-op time is tiny vs constant overhead; the median
+            # below absorbs that better than clamping would.
+            s.iter_seconds.append((t_long - t_short) / (iters - short))
+        if barrier is not None:
+            barrier()
+    except TransferTimeout:
+        s.timed_out = True
+        return s
+    # Robust point estimate: the median over repeats, clamped at zero
+    # (gbps() maps a zero/NaN per-op time to NaN rather than inf).
+    med = statistics.median(s.iter_seconds) if s.iter_seconds else math.nan
+    s.region_seconds = max(0.0, med) * len(s.iter_seconds)
     return s
 
 

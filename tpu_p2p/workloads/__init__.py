@@ -1,0 +1,15 @@
+"""L5 — benchmark workloads, registered by name for the CLI.
+
+Importing this package registers every pattern in
+:data:`tpu_p2p.workloads.base.WORKLOADS`.
+"""
+
+from tpu_p2p.workloads.base import WORKLOADS, WorkloadContext, workload  # noqa: F401
+from tpu_p2p.workloads import (  # noqa: F401  (registration side effects)
+    alltoall,
+    latency,
+    pairwise,
+    ring,
+    ring_attn,
+    torus,
+)

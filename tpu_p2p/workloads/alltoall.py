@@ -1,0 +1,68 @@
+"""all_to_all collective — BASELINE.json configs[3].
+
+The transport of Ulysses-style sequence parallelism and expert
+parallelism (SURVEY.md §2.3): every device splits its ``msg_size``
+buffer into ``n`` chunks and exchanges them with all peers in one XLA
+AllToAll. Accounting: each device *transmits* ``msg*(n-1)/n`` bytes
+(the self-chunk stays local), so per-device Gbps uses that numerator —
+the reference formula (p2p_matrix.cc:177) with the honest byte count.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from tpu_p2p.config import format_size
+from tpu_p2p.parallel import collectives as C
+from tpu_p2p.utils import timing
+from tpu_p2p.utils.errors import BackendError
+from tpu_p2p.workloads.base import WorkloadContext, cell_record, workload
+
+
+@workload("all_to_all")
+def run_all_to_all(ctx: WorkloadContext) -> list:
+    rt, cfg = ctx.rt, ctx.cfg
+    n = rt.num_devices
+    results = []
+    fn = ctx.cache.all_to_all(rt.mesh, "d")
+    for msg_bytes in cfg.sizes():
+        if msg_bytes % n:
+            raise BackendError(
+                f"all_to_all needs msg size divisible by {n} devices, got {msg_bytes}"
+            )
+        dtype = np.dtype(cfg.dtype)
+        x = ctx.payloads.get(rt.mesh, msg_bytes, dtype)
+        # all_to_all has no chain analogue with different semantics —
+        # repeated application is an involution-ish reshuffle — so both
+        # modes use the serialized host loop here.
+        s = timing.measure_serialized(
+            fn, x, cfg.iters, warmup=cfg.warmup, timeout_s=cfg.timeout_s,
+            barrier=rt.barrier,
+        )
+        sent = msg_bytes * (n - 1) // n
+        gbps_val = timing.gbps(sent, s.mean_region)
+        if cfg.check:
+            got = np.asarray(fn(x))
+            want = C.expected_all_to_all(
+                np.asarray(x).reshape(n, -1), n
+            ).reshape(np.asarray(x).shape)
+            if not np.array_equal(got, want):
+                raise BackendError(f"all_to_all payload verification failed at {msg_bytes}B")
+        if ctx.is_printer:
+            sys.stdout.write(
+                f"all_to_all {format_size(msg_bytes)} over {n} devices: "
+                f"{gbps_val:6.02f} Gbps/device tx  "
+                f"(p50 {s.p50 * 1e6:.1f}us, p99 {s.p99 * 1e6:.1f}us)\n"
+            )
+            sys.stdout.flush()
+        ctx.record(
+            cell_record(
+                ctx, workload="all_to_all", direction="uni", src=0, dst=0,
+                msg_bytes=msg_bytes, gbps_val=gbps_val, samples=s,
+                devices=n, bytes_tx_per_device=sent,
+            )
+        )
+        results.append({"msg_bytes": msg_bytes, "gbps_per_device_tx": gbps_val})
+    return results

@@ -1,0 +1,175 @@
+"""L5 — workload plumbing shared by all benchmark patterns.
+
+The reference drives its two workloads inline from ``main``
+(``/root/reference/p2p_matrix.cc:141-186,196-267``); here each named
+pattern (SURVEY.md §5 "long-context" — ``pairwise``, ``ring``,
+``all_to_all``, ``torus2d``, ``latency``, ``ring_attention``) is a
+function over a shared measurement core, registered for the CLI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+from tpu_p2p.config import BenchConfig
+from tpu_p2p.parallel import collectives as C
+from tpu_p2p.parallel.runtime import Runtime
+from tpu_p2p.utils import timing
+from tpu_p2p.utils.errors import BackendError
+from tpu_p2p.utils.report import CellRecord, JsonlWriter
+
+WORKLOADS: Dict[str, Callable] = {}
+
+
+def workload(name: str):
+    def deco(fn):
+        WORKLOADS[name] = fn
+        return fn
+
+    return deco
+
+
+class PayloadCache:
+    """Reuse device payload buffers across cells — the reference
+    allocates its send/recv buffers exactly once (p2p_matrix.cc:124-130)."""
+
+    def __init__(self) -> None:
+        self._cache: dict = {}
+
+    def get(self, mesh, msg_bytes: int, dtype) -> jax.Array:
+        key = (mesh, msg_bytes, str(dtype))
+        x = self._cache.get(key)
+        if x is None:
+            x = C.make_payload(mesh, msg_bytes, dtype)
+            self._cache[key] = x
+        return x
+
+
+@dataclass
+class WorkloadContext:
+    """Everything a workload needs, built once per run by the CLI."""
+
+    rt: Runtime
+    cfg: BenchConfig
+    cache: C.CollectiveCache = field(default_factory=C.CollectiveCache)
+    payloads: PayloadCache = field(default_factory=PayloadCache)
+    jsonl: Optional[JsonlWriter] = None
+    done: dict = field(default_factory=dict)
+
+    @property
+    def is_printer(self) -> bool:
+        """Rank-0 gating for stdout (p2p_matrix.cc:133 et al.)."""
+        return jax.process_index() == 0
+
+    def record(self, rec: CellRecord) -> None:
+        if self.jsonl is not None:
+            self.jsonl.write(rec)
+
+    def previously_done(self, key: tuple) -> Optional[float]:
+        if self.cfg.resume and key in self.done:
+            return self.done[key]
+        return None
+
+
+def measure_edges(
+    ctx: WorkloadContext,
+    mesh,
+    axis: str,
+    edges: Sequence[C.Edge],
+    msg_bytes: int,
+    *,
+    directions: int = 1,
+    bytes_per_device: Optional[int] = None,
+) -> tuple:
+    """Measure one edge set → (gbps, Samples).
+
+    ``serialized`` mode reproduces the reference's one-message-in-flight
+    loop (p2p_matrix.cc:154-171 — dispatch + full drain per message);
+    ``fused`` compiles ``iters`` data-dependent hops into one program
+    (device-serialized, no host dispatch) — SURVEY.md §7 hard part (c).
+
+    ``bytes_per_device`` overrides the numerator for collective patterns
+    where each device moves a different byte count than ``msg_bytes``
+    (e.g. all_to_all moves ``msg*(n-1)/n``).
+    """
+    cfg = ctx.cfg
+    dtype = np.dtype(cfg.dtype)
+    x = ctx.payloads.get(mesh, msg_bytes, dtype)
+    barrier = ctx.rt.barrier
+    if cfg.mode == "serialized":
+        fn = ctx.cache.permute(mesh, axis, edges)
+        s = timing.measure_serialized(
+            fn, x, cfg.iters, warmup=cfg.warmup, timeout_s=cfg.timeout_s,
+            barrier=barrier,
+        )
+    elif cfg.mode == "fused":
+        chain = ctx.cache.permute_chain(mesh, axis, edges, cfg.iters)
+        s = timing.measure_fused(
+            chain, x, cfg.iters, repeats=cfg.fused_repeats, warmup=cfg.warmup,
+            timeout_s=cfg.timeout_s, barrier=barrier,
+        )
+    else:  # differential — per-hop slope between two chain lengths
+        s = timing.measure_differential(
+            lambda k: ctx.cache.permute_chain(mesh, axis, edges, k),
+            x, cfg.iters, repeats=cfg.fused_repeats,
+            timeout_s=cfg.timeout_s, barrier=barrier,
+        )
+    nbytes = bytes_per_device if bytes_per_device is not None else msg_bytes
+    return timing.gbps(nbytes, s.mean_region, directions=directions), s
+
+
+def verify_edges(ctx: WorkloadContext, mesh, axis: str, edges, msg_bytes: int) -> None:
+    """Optional payload check (--check): dst rows must carry src tags.
+
+    The reference never validates transferred bytes (buffers zeroed at
+    p2p_matrix.cc:129-130, never read back) — SURVEY.md §4 item 2 makes
+    this first-class here.
+    """
+    dtype = np.dtype(ctx.cfg.dtype)
+    x = ctx.payloads.get(mesh, msg_bytes, dtype)
+    fn = ctx.cache.permute(mesh, axis, edges)
+    got = np.asarray(fn(x))
+    axis_dim = list(mesh.axis_names).index(axis)
+    want = C.expected_permute(np.asarray(x), edges, axis=axis_dim)
+    if not np.array_equal(got, want):
+        raise BackendError(
+            f"payload verification failed for edges {tuple(edges)} at {msg_bytes}B"
+        )
+
+
+def cell_record(
+    ctx: WorkloadContext,
+    *,
+    workload: str,
+    direction: str,
+    src: int,
+    dst: int,
+    msg_bytes: int,
+    gbps_val: float,
+    samples,
+    **extra,
+) -> CellRecord:
+    hops = None
+    if ctx.rt.torus is not None and src < ctx.rt.num_devices and dst < ctx.rt.num_devices:
+        hops = ctx.rt.torus.hops(src, dst)
+    return CellRecord(
+        workload=workload,
+        direction=direction,
+        src=src,
+        dst=dst,
+        msg_bytes=msg_bytes,
+        iters=ctx.cfg.iters,
+        mode=ctx.cfg.mode,
+        gbps=gbps_val,
+        mean_s=samples.mean,
+        p50_s=samples.p50,
+        p99_s=samples.p99,
+        min_s=samples.min,
+        timed_out=samples.timed_out,
+        hops=hops,
+        extra=extra,
+    )

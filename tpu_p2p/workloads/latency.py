@@ -1,0 +1,111 @@
+"""Small-message latency + loopback — BASELINE.json metric & configs[0].
+
+Two patterns the reference cannot measure (it keeps only a 128-iter
+mean at a fixed 32 MiB — p2p_matrix.cc:124,132,176):
+
+- ``latency``: p50/p99 send/recv latency at 8 B between a device pair,
+  serialized mode (dispatch-inclusive, SURVEY.md §7 hard part (e)) plus
+  a fused device-chain estimate that removes host dispatch.
+- ``loopback``: the 4 KiB same-host exchange of BASELINE configs[0] —
+  on a 1-device runtime a self-edge copy, otherwise the first
+  intra-host pair.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tpu_p2p.config import format_size
+from tpu_p2p.parallel import collectives as C
+from tpu_p2p.utils import timing
+from tpu_p2p.workloads.base import WorkloadContext, cell_record, workload
+
+LATENCY_BYTES = 8  # BASELINE.json "p50 send/recv latency @ 8B"
+LOOPBACK_BYTES = 4 * 1024  # configs[0] "2-rank 4KB send/recv loopback"
+
+
+def _measure_pair_latency(ctx: WorkloadContext, src: int, dst: int, nbytes: int):
+    """Serialized p50 + fused per-hop time for one directed pair."""
+    rt, cfg = ctx.rt, ctx.cfg
+    edges = C.unidir_edges(src, dst) if src != dst else ((src, src),)
+    mesh, axis = rt.mesh, "d"
+    if cfg.isolation == "submesh" and src != dst:
+        mesh = rt.submesh([src, dst])
+        edges = ((0, 1),)
+    fn = ctx.cache.permute(mesh, axis, edges)
+    x = ctx.payloads.get(mesh, nbytes, ctx.cfg.dtype)
+    ser = timing.measure_serialized(
+        fn, x, cfg.iters, warmup=max(1, cfg.warmup), timeout_s=cfg.timeout_s,
+        barrier=rt.barrier,
+    )
+    # Fused chain: iters data-dependent hops in one program — the
+    # dispatch-free device-side hop time (SURVEY.md §7(e)).
+    chain = ctx.cache.permute_chain(mesh, axis, edges, cfg.iters)
+    fused = timing.measure_fused(
+        chain, x, cfg.iters, repeats=cfg.fused_repeats,
+        warmup=max(1, cfg.warmup), timeout_s=cfg.timeout_s, barrier=rt.barrier,
+    )
+    return ser, fused
+
+
+@workload("latency")
+def run_latency(ctx: WorkloadContext) -> dict:
+    rt = ctx.rt
+    n = rt.num_devices
+    src, dst = (0, 1) if n > 1 else (0, 0)
+    nbytes = ctx.cfg.msg_size if ctx.cfg.msg_size != 32 * 1024 * 1024 else LATENCY_BYTES
+    ser, fused = _measure_pair_latency(ctx, src, dst, nbytes)
+    if ctx.is_printer:
+        sys.stdout.write(
+            f"latency {format_size(nbytes)} {src}->{dst}: "
+            f"p50 {ser.p50 * 1e6:.2f}us  p99 {ser.p99 * 1e6:.2f}us  "
+            f"min {ser.min * 1e6:.2f}us (serialized, dispatch-inclusive); "
+            f"per-hop {fused.mean * 1e6:.2f}us (fused device chain)\n"
+        )
+        sys.stdout.flush()
+    ctx.record(
+        cell_record(
+            ctx, workload="latency", direction="uni", src=src, dst=dst,
+            msg_bytes=nbytes, gbps_val=timing.gbps(nbytes, ser.mean_region),
+            samples=ser, fused_hop_s=fused.mean,
+        )
+    )
+    return {
+        "src": src, "dst": dst, "bytes": nbytes,
+        "p50_us": ser.p50 * 1e6, "p99_us": ser.p99 * 1e6,
+        "fused_hop_us": fused.mean * 1e6,
+    }
+
+
+@workload("loopback")
+def run_loopback(ctx: WorkloadContext) -> dict:
+    """configs[0]: 2-rank 4 KiB exchange on one host (self-edge when
+    only one device is visible — measures the dispatch+copy floor)."""
+    rt = ctx.rt
+    n = rt.num_devices
+    # first intra-host pair, else self-edge
+    src, dst = 0, 0
+    for i in range(1, n):
+        if rt.placement.host_of[i] == rt.placement.host_of[0]:
+            src, dst = 0, i
+            break
+    nbytes = ctx.cfg.msg_size if ctx.cfg.msg_size != 32 * 1024 * 1024 else LOOPBACK_BYTES
+    ser, fused = _measure_pair_latency(ctx, src, dst, nbytes)
+    bw = timing.gbps(nbytes, ser.mean_region)
+    if ctx.is_printer:
+        kind = "self-edge" if src == dst else "intra-host pair"
+        sys.stdout.write(
+            f"loopback ({kind} {src}->{dst}) {format_size(nbytes)}: "
+            f"{bw:6.02f} Gbps  p50 {ser.p50 * 1e6:.2f}us  "
+            f"per-hop {fused.mean * 1e6:.2f}us (fused)\n"
+        )
+        sys.stdout.flush()
+    ctx.record(
+        cell_record(
+            ctx, workload="loopback", direction="uni", src=src, dst=dst,
+            msg_bytes=nbytes, gbps_val=bw, samples=ser,
+            fused_hop_s=fused.mean,
+        )
+    )
+    return {"src": src, "dst": dst, "bytes": nbytes, "gbps": bw,
+            "p50_us": ser.p50 * 1e6}

@@ -1,0 +1,123 @@
+"""The all-pairs P2P bandwidth matrix — the reference program itself.
+
+Reproduces both sweeps of ``/root/reference/p2p_matrix.cc``:
+
+- uni-directional (``:141-186``): for each ordered pair, a single-edge
+  ``ppermute`` measured with per-message drain;
+- bi-directional (``:196-267``): both directed edges in one
+  ``ppermute`` (the ``ncclGroupStart/End`` + two-stream full-duplex
+  trick dissolves into the collective — SURVEY.md §3.4), throughput
+  ×2 (``:258``).
+
+Semantic difference designed around (SURVEY.md §3.5): XLA collectives
+are programs all mesh devices execute, so non-pair devices can't simply
+idle in an ``else`` branch (``p2p_matrix.cc:155-171``). Two isolation
+modes (§7 hard part (a)):
+
+- ``full``: one N-device program whose permutation contains only the
+  pair's edge(s); non-participants enter the collective with no edges.
+- ``submesh``: a 2-device mesh over just the pair — true isolation at
+  the cost of per-pair compilation.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tpu_p2p.config import format_size
+from tpu_p2p.parallel import collectives as C
+from tpu_p2p.utils.report import MatrixReporter
+from tpu_p2p.workloads.base import (
+    WorkloadContext,
+    cell_record,
+    measure_edges,
+    verify_edges,
+    workload,
+)
+
+
+def _pair_edges(direction: str, src: int, dst: int):
+    if direction == "uni":
+        return C.unidir_edges(src, dst), 1
+    return C.bidir_edges(src, dst), 2
+
+
+def _run_matrix(ctx: WorkloadContext, direction: str, msg_bytes: int) -> dict:
+    rt, cfg = ctx.rt, ctx.cfg
+    n = rt.num_devices
+    title = (
+        f"Evaluating the {'Uni' if direction == 'uni' else 'Bi'}-Directional "
+        f"TPU P2P Bandwidth (Gbps)"
+    )
+    stream = sys.stdout if ctx.is_printer else None
+    rep = MatrixReporter(n, title, stream if stream else _NullStream())
+    rep.header()
+    for src, dst in C.all_pairs(n):
+        if dst == 0:
+            rep.row_label(src)
+        rt.barrier()  # p2p_matrix.cc:146/:201 — align before each cell
+        if src == dst:
+            rep.diagonal(src)  # p2p_matrix.cc:147-151
+            if dst == n - 1:
+                rep.end_row()
+            continue
+        key = ("pairwise", direction, src, dst, msg_bytes, cfg.mode)
+        prev = ctx.previously_done(key)
+        if prev is not None:
+            rep.cell(src, dst, prev)
+            if dst == n - 1:
+                rep.end_row()
+            continue
+        edges, directions = _pair_edges(direction, src, dst)
+        if cfg.isolation == "submesh":
+            mesh = rt.submesh([src, dst])
+            local = {src: 0, dst: 1}
+            sub_edges = tuple((local[a], local[b]) for a, b in edges)
+            gbps_val, samples = measure_edges(
+                ctx, mesh, "d", sub_edges, msg_bytes, directions=directions
+            )
+            if cfg.check:
+                verify_edges(ctx, mesh, "d", sub_edges, msg_bytes)
+        else:
+            gbps_val, samples = measure_edges(
+                ctx, rt.mesh, "d", edges, msg_bytes, directions=directions
+            )
+            if cfg.check:
+                verify_edges(ctx, rt.mesh, "d", edges, msg_bytes)
+        rep.cell(src, dst, gbps_val)
+        ctx.record(
+            cell_record(
+                ctx, workload="pairwise", direction=direction, src=src,
+                dst=dst, msg_bytes=msg_bytes, gbps_val=gbps_val,
+                samples=samples, isolation=cfg.isolation,
+            )
+        )
+        if dst == n - 1:
+            rep.end_row()
+    summary = rep.print_summary(
+        f"pairwise {direction}-dir {format_size(msg_bytes)} {cfg.mode}"
+    )
+    return {"direction": direction, "msg_bytes": msg_bytes, **summary}
+
+
+class _NullStream:
+    def write(self, _):
+        pass
+
+    def flush(self):
+        pass
+
+
+@workload("pairwise")
+def run_pairwise(ctx: WorkloadContext) -> list:
+    """Full sweep: uni then bi (reference order, p2p_matrix.cc:141,196),
+    over every size in the sweep (BASELINE configs[1])."""
+    results = []
+    for msg_bytes in ctx.cfg.sizes():
+        if ctx.cfg.direction in ("uni", "both"):
+            results.append(_run_matrix(ctx, "uni", msg_bytes))
+        if ctx.cfg.direction in ("bi", "both"):
+            if ctx.is_printer and ctx.cfg.direction == "both":
+                sys.stdout.write("\n")  # p2p_matrix.cc:189 leading newline
+            results.append(_run_matrix(ctx, "bi", msg_bytes))
+    return results

@@ -1,0 +1,54 @@
+"""Ring ppermute (shift-by-k) — BASELINE.json configs[2].
+
+The transport of ring attention / ring context-parallelism
+(SURVEY.md §2.3, §5): every device sends its payload to
+``(i + shift) % n`` simultaneously — the all-links-busy counterpart of
+the reference's one-pair-at-a-time sweep. Per-device bandwidth uses the
+reference formula (p2p_matrix.cc:177) with each device moving
+``msg_size`` bytes per hop.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tpu_p2p.config import format_size
+from tpu_p2p.parallel import collectives as C
+from tpu_p2p.workloads.base import (
+    WorkloadContext,
+    cell_record,
+    measure_edges,
+    verify_edges,
+    workload,
+)
+
+
+@workload("ring")
+def run_ring(ctx: WorkloadContext, shift: int = 1) -> list:
+    rt, cfg = ctx.rt, ctx.cfg
+    n = rt.num_devices
+    results = []
+    for msg_bytes in cfg.sizes():
+        edges = C.ring_edges(n, shift)
+        gbps_val, samples = measure_edges(ctx, rt.mesh, "d", edges, msg_bytes)
+        if cfg.check:
+            verify_edges(ctx, rt.mesh, "d", edges, msg_bytes)
+        if ctx.is_printer:
+            sys.stdout.write(
+                f"ring shift-by-{shift} {format_size(msg_bytes)} {cfg.mode}: "
+                f"{gbps_val:6.02f} Gbps/device  "
+                f"(p50 {samples.p50 * 1e6:.1f}us, p99 {samples.p99 * 1e6:.1f}us, "
+                f"{n} devices all sending)\n"
+            )
+            sys.stdout.flush()
+        ctx.record(
+            cell_record(
+                ctx, workload="ring", direction="uni", src=0,
+                dst=shift % n, msg_bytes=msg_bytes, gbps_val=gbps_val,
+                samples=samples, shift=shift, devices=n,
+            )
+        )
+        results.append(
+            {"shift": shift, "msg_bytes": msg_bytes, "gbps_per_device": gbps_val}
+        )
+    return results

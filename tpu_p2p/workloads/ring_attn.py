@@ -1,0 +1,71 @@
+"""ring_attention workload — overlapped comm+compute measurement.
+
+Where the ``ring`` pattern measures the bare shift-by-1 transport
+(BASELINE.json configs[2]), this workload runs real sequence-parallel
+attention over that same transport
+(:func:`tpu_p2p.ops.attention.ring_attention_local`) and reports step
+latency, achieved attention FLOP/s, and the KV bytes each device ships
+per step — the number a long-context training stack actually cares
+about (SURVEY.md §5 "long-context / sequence parallelism").
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import numpy as np
+
+from tpu_p2p.models.ring_transformer import ModelConfig
+from tpu_p2p.ops import attention as A
+from tpu_p2p.utils import timing
+from tpu_p2p.workloads.base import WorkloadContext, cell_record, workload
+
+
+@workload("ring_attention")
+def run_ring_attention(ctx: WorkloadContext, model_cfg: ModelConfig = None) -> dict:
+    rt, cfg = ctx.rt, ctx.cfg
+    n = rt.num_devices
+    axis = rt.mesh.axis_names[0]
+    mc = model_cfg or ModelConfig(seq=max(512, 64 * n))
+    rng = np.random.default_rng(cfg.seed)
+    shape = (mc.batch, mc.heads, mc.seq, mc.head_dim)
+    sharding = A.attention_sharding(rt.mesh, axis)
+    q, k, v = (
+        jax.device_put(
+            np.asarray(rng.standard_normal(shape), dtype=mc.dtype), sharding
+        )
+        for _ in range(3)
+    )
+    fn = A.ring_attention(rt.mesh, axis, mc.causal)
+    s = timing.measure_serialized(
+        lambda args: fn(*args), (q, k, v), cfg.iters,
+        warmup=max(1, cfg.warmup), timeout_s=cfg.timeout_s, barrier=rt.barrier,
+    )
+    flops = A.flops_per_step(mc.batch, mc.heads, mc.seq, mc.head_dim, causal=mc.causal)
+    hop_bytes = A.kv_bytes_per_hop(mc.batch, mc.heads, mc.seq // n, mc.head_dim, mc.dtype)
+    step_s = s.p50
+    tflops = flops / step_s / 1e12 if step_s == step_s else float("nan")
+    comm_gbps = timing.gbps(hop_bytes * (n - 1), s.mean_region)
+    if ctx.is_printer:
+        sys.stdout.write(
+            f"ring_attention B{mc.batch} H{mc.heads} T{mc.seq} D{mc.head_dim} "
+            f"{'causal ' if mc.causal else ''}over {n} devices: "
+            f"p50 {s.p50 * 1e3:.2f}ms/step  {tflops:.3f} TFLOP/s  "
+            f"{hop_bytes} KV bytes/hop x {n - 1} hops "
+            f"({comm_gbps:.2f} Gbps overlapped)\n"
+        )
+        sys.stdout.flush()
+    ctx.record(
+        cell_record(
+            ctx, workload="ring_attention", direction="uni", src=0, dst=1 % n,
+            msg_bytes=hop_bytes, gbps_val=comm_gbps, samples=s,
+            seq=mc.seq, batch=mc.batch, heads=mc.heads, head_dim=mc.head_dim,
+            tflops=tflops, causal=mc.causal,
+        )
+    )
+    return {
+        "devices": n, "seq": mc.seq, "p50_ms": s.p50 * 1e3,
+        "tflops": tflops, "kv_bytes_per_hop": hop_bytes,
+        "comm_gbps_overlapped": comm_gbps,
+    }

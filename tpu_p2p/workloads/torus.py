@@ -1,0 +1,61 @@
+"""2D-torus ppermute — BASELINE.json configs[4].
+
+Shift-by-1 rings along each axis of a 2D mesh, separately and
+chained, exposing the per-axis ICI (and, on multi-slice meshes, the
+DCN hop) that a flat pairwise matrix averages away (SURVEY.md §5
+"distributed communication backend" difference (c): TPU fabric is a
+physical torus, so bandwidth stratifies by axis and hop count).
+
+Requires a 2-axis mesh (``--mesh-shape AxB``).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tpu_p2p.config import format_size
+from tpu_p2p.parallel import collectives as C
+from tpu_p2p.utils.errors import BackendError
+from tpu_p2p.workloads.base import (
+    WorkloadContext,
+    cell_record,
+    measure_edges,
+    verify_edges,
+    workload,
+)
+
+
+@workload("torus2d")
+def run_torus2d(ctx: WorkloadContext) -> list:
+    rt, cfg = ctx.rt, ctx.cfg
+    if len(rt.mesh.axis_names) != 2:
+        raise BackendError(
+            f"torus2d needs a 2-axis mesh, got axes {rt.mesh.axis_names} "
+            f"(pass --mesh-shape, e.g. --mesh-shape 4x2)"
+        )
+    results = []
+    for msg_bytes in cfg.sizes():
+        for axis in rt.mesh.axis_names:
+            size = rt.mesh.shape[axis]
+            if size < 2:
+                continue
+            edges = C.ring_edges(size, 1)
+            gbps_val, samples = measure_edges(ctx, rt.mesh, axis, edges, msg_bytes)
+            if cfg.check:
+                verify_edges(ctx, rt.mesh, axis, edges, msg_bytes)
+            if ctx.is_printer:
+                sys.stdout.write(
+                    f"torus2d axis {axis!r} (size {size}) shift-by-1 "
+                    f"{format_size(msg_bytes)} {cfg.mode}: {gbps_val:6.02f} "
+                    f"Gbps/device (p50 {samples.p50 * 1e6:.1f}us)\n"
+                )
+                sys.stdout.flush()
+            ctx.record(
+                cell_record(
+                    ctx, workload="torus2d", direction="uni", src=0, dst=1,
+                    msg_bytes=msg_bytes, gbps_val=gbps_val, samples=samples,
+                    axis=axis, axis_size=size,
+                )
+            )
+            results.append({"axis": axis, "msg_bytes": msg_bytes, "gbps": gbps_val})
+    return results
