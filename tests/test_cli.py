@@ -13,7 +13,8 @@ def _cfg(argv):
 
 def test_defaults_match_reference():
     cfg = _cfg([])
-    assert cfg.msg_size == 32 * 1024 * 1024
+    assert cfg.msg_size is None  # unset → sizes() yields the reference 32 MiB
+    assert cfg.sizes() == (32 * 1024 * 1024,)
     assert cfg.iters == 128
     assert cfg.dtype == "int8"
     assert cfg.pattern == "pairwise" and cfg.direction == "both"

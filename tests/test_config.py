@@ -16,7 +16,8 @@ from tpu_p2p.config import (
 
 def test_defaults_are_reference_constants():
     cfg = BenchConfig()
-    assert cfg.msg_size == 32 * 1024 * 1024 == REF_MSG_SIZE
+    assert cfg.msg_size is None  # unset sentinel
+    assert cfg.sizes() == (REF_MSG_SIZE,) == (32 * 1024 * 1024,)
     assert cfg.iters == 128 == REF_ITERS
     assert cfg.dtype == "int8" == REF_DTYPE
     assert cfg.direction == "both"  # reference runs uni then bi

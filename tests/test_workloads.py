@@ -113,9 +113,9 @@ def test_all_to_all_rejects_indivisible_size(rt):
 
 
 def test_latency_workload_reports_percentiles(rt, capsys):
-    ctx = _ctx(rt, pattern="latency", iters=4, msg_size=32 * 1024 * 1024)
+    ctx = _ctx(rt, pattern="latency", iters=4, msg_size=None)
     res = run_latency(ctx)
-    assert res["bytes"] == 8  # default 32MiB swaps to the 8B metric size
+    assert res["bytes"] == 8  # unset → the 8B metric size
     assert res["p50_us"] > 0 and res["p99_us"] >= res["p50_us"]
     assert "dispatch-inclusive" in capsys.readouterr().out
 

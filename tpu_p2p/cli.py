@@ -42,8 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--pattern", choices=PATTERNS, default="pairwise",
                    help="workload to run (default: the reference's all-pairs matrix)")
-    p.add_argument("--msg-size", default="32MiB", metavar="SIZE",
-                   help="payload per message, e.g. 4KiB, 32MiB, 1GiB (reference: 32MiB)")
+    p.add_argument("--msg-size", default=None, metavar="SIZE",
+                   help="payload per message, e.g. 4KiB, 32MiB, 1GiB "
+                        "(default: 32MiB per the reference; latency/loopback "
+                        "default to their metric sizes 8B/4KiB)")
     p.add_argument("--sweep", default=None, metavar="LO:HI|A,B,...",
                    help="message-size sweep: power-of-two range '1KiB:1GiB' or explicit list")
     p.add_argument("--iters", type=int, default=128,
@@ -92,7 +94,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
             )
     return BenchConfig(
         pattern=args.pattern,
-        msg_size=parse_size(args.msg_size),
+        msg_size=parse_size(args.msg_size) if args.msg_size is not None else None,
         iters=args.iters,
         warmup=args.warmup,
         dtype=args.dtype,

@@ -83,7 +83,10 @@ class BenchConfig:
     """Everything a run needs; defaults = the reference's constants."""
 
     pattern: str = "pairwise"
-    msg_size: int = REF_MSG_SIZE
+    # None = unset; bandwidth patterns then use the reference's 32 MiB
+    # (via sizes()), while latency/loopback substitute their own metric
+    # sizes. An explicit value is always honored verbatim.
+    msg_size: Optional[int] = None
     iters: int = REF_ITERS
     warmup: int = 1  # deviation from reference (0 there): excludes XLA compile
     dtype: str = REF_DTYPE
@@ -114,7 +117,9 @@ class BenchConfig:
             raise ValueError("iters must be positive")
 
     def sizes(self) -> Tuple[int, ...]:
-        return self.sweep if self.sweep else (self.msg_size,)
+        if self.sweep:
+            return self.sweep
+        return (self.msg_size if self.msg_size is not None else REF_MSG_SIZE,)
 
     def replace(self, **kw) -> "BenchConfig":
         d = {f.name: getattr(self, f.name) for f in fields(self)}

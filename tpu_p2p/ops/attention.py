@@ -73,7 +73,8 @@ def _merge(o, m, l, s, v):
     return o_new, m_new, l_new
 
 
-def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False):
+def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
+                         use_flash: bool = False):
     """Per-shard ring attention body — call inside ``shard_map``.
 
     ``q, k, v``: local blocks ``[B, H, T_local, D]``, sequence sharded
@@ -81,6 +82,11 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False):
     (edge set ``ring_edges(n)``, the ``ring`` workload's transport)
     while each device accumulates attention of its queries over every
     block — ``n - 1`` ``ppermute`` hops overlapped with compute.
+
+    ``use_flash=True`` runs each block's accumulate step in the Pallas
+    kernel (:func:`tpu_p2p.ops.flash_attention.flash_carry_block`) —
+    the forward/benchmark fast path; keep the default jnp path for
+    training (the Pallas carry step has no VJP).
     """
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -101,9 +107,19 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False):
         visible = q_pos[:, None] >= k_pos[None, :]
         return jnp.where(visible[None, None], s, NEG_INF)
 
+    def accumulate(o, m, l, k_blk, v_blk, src_block):
+        if use_flash:
+            from tpu_p2p.ops.flash_attention import flash_carry_block
+
+            return flash_carry_block(
+                q, k_blk, v_blk, o, m, l, my * t, src_block * t,
+                causal=causal,
+            )
+        s = block_mask(_block_scores(q, k_blk, scale), src_block)
+        return _merge(o, m, l, s, v_blk)
+
     # Local block first (no hop needed)…
-    s0 = block_mask(_block_scores(q, k, scale), my)
-    o, m, l = _merge(o, m, l, s0, v)
+    o, m, l = accumulate(o, m, l, k, v, my)
 
     # …then n-1 rotate-and-accumulate hops.
     def hop(carry, i):
@@ -111,8 +127,7 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False):
         k_nxt = jax.lax.ppermute(k_cur, axis_name, edges)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, edges)
         src = jax.lax.rem(my - i - 1 + n + n, n)  # block now held locally
-        s = block_mask(_block_scores(q, k_nxt, scale), src)
-        o2, m2, l2 = _merge(o, m, l, s, v_nxt)
+        o2, m2, l2 = accumulate(o, m, l, k_nxt, v_nxt, src)
         return (o2, m2, l2, k_nxt, v_nxt), None
 
     if n > 1:
@@ -127,7 +142,8 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False):
 
 
 @functools.lru_cache(maxsize=None)
-def ring_attention(mesh: Mesh, axis: str, causal: bool = False):
+def ring_attention(mesh: Mesh, axis: str, causal: bool = False,
+                   use_flash: bool = False):
     """Jitted global ring attention over ``mesh``.
 
     Takes global ``[B, H, T, D]`` arrays with ``T`` sharded along
@@ -137,10 +153,15 @@ def ring_attention(mesh: Mesh, axis: str, causal: bool = False):
     spec = P(None, None, axis, None)
 
     def f(q, k, v):
-        return ring_attention_local(q, k, v, axis, causal=causal)
+        return ring_attention_local(q, k, v, axis, causal=causal,
+                                    use_flash=use_flash)
 
+    # check_vma=False on the flash path: JAX's varying-manual-axes
+    # tracking mis-propagates through pallas_call (its own error text
+    # suggests this workaround).
     return jax.jit(
-        jax.shard_map(f, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        jax.shard_map(f, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec, check_vma=not use_flash)
     )
 
 

@@ -1,0 +1,262 @@
+"""Flash attention — Pallas TPU kernel for the framework's hot op.
+
+The reference has no compute kernels at all (its entire program is a
+transport benchmark, ``/root/reference/p2p_matrix.cc``); this module is
+the TPU-native compute half that pairs with the transport layer: the
+blockwise online-softmax attention kernel that
+:mod:`tpu_p2p.ops.attention`'s ring attention streams KV blocks
+through. Written per the Pallas TPU playbook — data staged
+HBM→VMEM by ``BlockSpec``, scores on the MXU via ``dot_general`` with
+``preferred_element_type=float32``, accumulators carried in float32,
+static shapes throughout.
+
+Two entry points:
+
+- :func:`flash_attention` — standalone fused attention over a local
+  ``[B, H, T, D]`` block (the dense-path replacement). Differentiable
+  via ``custom_vjp`` (backward recomputes with the jnp oracle under
+  ``jax.checkpoint``; a Pallas backward kernel is a future round).
+- :func:`flash_carry_block` — one KV-block accumulate pass taking and
+  returning the ``(o, m, l)`` streaming-softmax carry, used by
+  ``ring_attention_local(..., use_flash=True)`` so each ring hop's
+  compute runs in the kernel while ``ppermute`` rotates the next block.
+
+On CPU (the test mesh) kernels run in interpreter mode automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_p2p.ops.attention import NEG_INF
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _pick_block(t: int, pref: int = 128) -> int:
+    """Largest power-of-two tile <= pref that divides t (worst case 1,
+    since 1 divides everything)."""
+    b = pref
+    while b > 1 and t % b:
+        b //= 2
+    return b
+
+
+def _match_vma(x, axes):
+    """Mark ``x`` as varying over any of ``axes`` it isn't yet — keeps
+    fori_loop carry types stable under shard_map's vma checking."""
+    missing = tuple(a for a in axes if a not in getattr(jax.typeof(x), "vma", ()))
+    return jax.lax.pvary(x, missing) if missing else x
+
+
+def _kernel(offs_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
+            o_ref, m_ref, l_ref, *, block_k: int, causal: bool, scale: float,
+            vma_axes: tuple = ()):
+    """Grid cell = (batch*head, one q block). Streams the full local KV
+    through VMEM in ``block_k`` tiles, folding each into the online
+    softmax carry (the same update as ``attention._merge``)."""
+    q = q_ref[0]                       # (bq, D)
+    bq = q.shape[0]
+    t_kv = k_ref.shape[1]
+    num_kb = t_kv // block_k
+
+    o = o0_ref[0].astype(jnp.float32)  # (bq, D)
+    m = m0_ref[0].astype(jnp.float32)  # (bq,)
+    l = l0_ref[0].astype(jnp.float32)
+
+    j = pl.program_id(1)
+    q_pos = offs_ref[0] + j * bq + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, 1), 0
+    ).squeeze(-1)
+
+    def body(kb, carry):
+        o, m, l = carry
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                      # (bq, bk)
+        if causal:
+            k_pos = offs_ref[1] + kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            visible = q_pos[:, None] >= k_pos
+            s = jnp.where(visible, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            # Explicit zero on masked lanes: a fully-masked row has
+            # s == m_new == NEG_INF and exp(0) == 1 would corrupt l.
+            p = jnp.where(visible, p, 0.0)
+        l_new = l * alpha + p.sum(axis=-1)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_new = o * alpha[:, None] + pv
+        return tuple(_match_vma(x, vma_axes) for x in (o_new, m_new, l_new))
+
+    init = tuple(_match_vma(x, vma_axes) for x in (o, m, l))
+    o, m, l = jax.lax.fori_loop(0, num_kb, body, init)
+    o_ref[0] = o
+    m_ref[0] = m
+    l_ref[0] = l
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret"),
+)
+def _flash_call(q3, k3, v3, o0, m0, l0, q_off, k_off, *,
+                causal: bool, block_q: int, block_k: int, interpret: bool):
+    """One accumulate pass of q3 against the whole of k3/v3.
+
+    Shapes: ``q3 [BH, Tq, D]``, ``k3/v3 [BH, Tk, D]``, carry
+    ``o0 [BH, Tq, D] f32``, ``m0/l0 [BH, Tq] f32``. Returns the updated
+    un-normalized carry; :func:`finalize` divides by ``l``.
+    """
+    bh, tq, d = q3.shape
+    tk = k3.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    offs = jnp.array([q_off, k_off], jnp.int32).reshape(2)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, s: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j, s: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j, s: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, s: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j, s: (i, j)),
+            pl.BlockSpec((1, block_q), lambda i, j, s: (i, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, s: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j, s: (i, j)),
+            pl.BlockSpec((1, block_q), lambda i, j, s: (i, j)),
+        ],
+    )
+    # Inside shard_map, outputs must carry varying-mesh-axes typing:
+    # they vary over every axis any input varies over (e.g. "sp" when
+    # called from ring attention).
+    vma = frozenset().union(
+        *(getattr(jax.typeof(a), "vma", frozenset())
+          for a in (q3, k3, v3, o0, m0, l0))
+    )
+    kernel = functools.partial(
+        _kernel, block_k=block_k, causal=causal, scale=scale,
+        vma_axes=tuple(sorted(vma)),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, tq), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, tq), jnp.float32, vma=vma),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * tq * tk * d,
+            bytes_accessed=2 * bh * (tq + 2 * tk) * d * q3.dtype.itemsize,
+            transcendentals=bh * tq * tk,
+        ),
+        interpret=interpret,
+    )(offs, q3, k3, v3, o0, m0, l0)
+
+
+def zero_carry(bh: int, t: int, d: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fresh (o, m, l) streaming-softmax accumulators."""
+    return (
+        jnp.zeros((bh, t, d), jnp.float32),
+        jnp.full((bh, t), NEG_INF, jnp.float32),
+        jnp.zeros((bh, t), jnp.float32),
+    )
+
+
+def finalize(o, m, l, dtype):
+    """Normalize the carry into attention output (l==0 rows → 0)."""
+    del m
+    safe = jnp.where(l == 0.0, 1.0, l)
+    return (o / safe[..., None]).astype(dtype)
+
+
+def flash_carry_block(q, k, v, o, m, l, q_off, k_off, *,
+                      causal: bool = False, interpret=None):
+    """Fold one KV block into the carry — the ring-hop compute step.
+
+    ``q [B, H, Tq, D]`` against ``k/v [B, H, Tk, D]`` with global
+    position offsets (traced scalars are fine — they ride scalar
+    prefetch). Carry shapes: ``o [B, H, Tq, D] f32``, ``m/l [B, H, Tq]
+    f32``.
+    """
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bh = b * h
+    interpret = _interpret_default() if interpret is None else interpret
+    o3, m3, l3 = _flash_call(
+        q.reshape(bh, tq, d), k.reshape(bh, tk, d), v.reshape(bh, tk, d),
+        o.reshape(bh, tq, d), m.reshape(bh, tq), l.reshape(bh, tq),
+        q_off, k_off,
+        causal=causal,
+        block_q=_pick_block(tq),
+        block_k=_pick_block(tk),
+        interpret=interpret,
+    )
+    return (
+        o3.reshape(b, h, tq, d),
+        m3.reshape(b, h, tq),
+        l3.reshape(b, h, tq),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(q, k, v, causal: bool = False):
+    """Fused single-device attention, ``[B, H, T, D]`` → same.
+
+    Forward runs the Pallas kernel; backward recomputes through the
+    jnp oracle under ``jax.checkpoint`` (O(T²) compute, no stored
+    probability matrix).
+    """
+    return _flash_fwd_impl(q, k, v, causal)
+
+
+def _flash_fwd_impl(q, k, v, causal):
+    b, h, t, d = q.shape
+    bh = b * h
+    o0, m0, l0 = zero_carry(bh, t, d)
+    o, m, l = _flash_call(
+        q.reshape(bh, t, d), k.reshape(bh, t, d), v.reshape(bh, t, d),
+        o0, m0, l0, 0, 0,
+        causal=causal,
+        block_q=_pick_block(t),
+        block_k=_pick_block(t),
+        interpret=_interpret_default(),
+    )
+    return finalize(o, m, l, q.dtype).reshape(b, h, t, d)
+
+
+def _flash_fwd(q, k, v, causal):
+    return _flash_fwd_impl(q, k, v, causal), (q, k, v)
+
+
+def _flash_bwd(causal, res, g):
+    from tpu_p2p.ops.attention import dense_attention
+
+    q, k, v = res
+    f = jax.checkpoint(lambda q, k, v: dense_attention(q, k, v, causal=causal))
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -7,7 +7,8 @@ mean at a fixed 32 MiB — p2p_matrix.cc:124,132,176):
   serialized mode (dispatch-inclusive, SURVEY.md §7 hard part (e)) plus
   a fused device-chain estimate that removes host dispatch.
 - ``loopback``: the 4 KiB same-host exchange of BASELINE configs[0] —
-  on a 1-device runtime a self-edge copy, otherwise the first
+  on a 1-device runtime an honest full-buffer rewrite chain (a
+  self-edge ``ppermute`` would be compiled away), otherwise the first
   intra-host pair.
 """
 
@@ -27,20 +28,27 @@ LOOPBACK_BYTES = 4 * 1024  # configs[0] "2-rank 4KB send/recv loopback"
 def _measure_pair_latency(ctx: WorkloadContext, src: int, dst: int, nbytes: int):
     """Serialized p50 + fused per-hop time for one directed pair."""
     rt, cfg = ctx.rt, ctx.cfg
-    edges = C.unidir_edges(src, dst) if src != dst else ((src, src),)
     mesh, axis = rt.mesh, "d"
-    if cfg.isolation == "submesh" and src != dst:
-        mesh = rt.submesh([src, dst])
-        edges = ((0, 1),)
-    fn = ctx.cache.permute(mesh, axis, edges)
+    if src == dst:
+        # A self-edge ppermute is an identity XLA deletes outright
+        # (collectives.loopback_chain docstring); measure the honest
+        # dispatch+full-buffer-rewrite floor instead.
+        fn = ctx.cache.loopback_chain(mesh, 1)
+        chain = ctx.cache.loopback_chain(mesh, cfg.iters)
+    else:
+        edges = C.unidir_edges(src, dst)
+        if cfg.isolation == "submesh":
+            mesh = rt.submesh([src, dst])
+            edges = ((0, 1),)
+        fn = ctx.cache.permute(mesh, axis, edges)
+        # Fused chain: iters data-dependent hops in one program — the
+        # dispatch-free device-side hop time (SURVEY.md §7(e)).
+        chain = ctx.cache.permute_chain(mesh, axis, edges, cfg.iters)
     x = ctx.payloads.get(mesh, nbytes, ctx.cfg.dtype)
     ser = timing.measure_serialized(
         fn, x, cfg.iters, warmup=max(1, cfg.warmup), timeout_s=cfg.timeout_s,
         barrier=rt.barrier,
     )
-    # Fused chain: iters data-dependent hops in one program — the
-    # dispatch-free device-side hop time (SURVEY.md §7(e)).
-    chain = ctx.cache.permute_chain(mesh, axis, edges, cfg.iters)
     fused = timing.measure_fused(
         chain, x, cfg.iters, repeats=cfg.fused_repeats,
         warmup=max(1, cfg.warmup), timeout_s=cfg.timeout_s, barrier=rt.barrier,
@@ -53,7 +61,7 @@ def run_latency(ctx: WorkloadContext) -> dict:
     rt = ctx.rt
     n = rt.num_devices
     src, dst = (0, 1) if n > 1 else (0, 0)
-    nbytes = ctx.cfg.msg_size if ctx.cfg.msg_size != 32 * 1024 * 1024 else LATENCY_BYTES
+    nbytes = ctx.cfg.msg_size if ctx.cfg.msg_size is not None else LATENCY_BYTES
     ser, fused = _measure_pair_latency(ctx, src, dst, nbytes)
     if ctx.is_printer:
         sys.stdout.write(
@@ -89,7 +97,7 @@ def run_loopback(ctx: WorkloadContext) -> dict:
         if rt.placement.host_of[i] == rt.placement.host_of[0]:
             src, dst = 0, i
             break
-    nbytes = ctx.cfg.msg_size if ctx.cfg.msg_size != 32 * 1024 * 1024 else LOOPBACK_BYTES
+    nbytes = ctx.cfg.msg_size if ctx.cfg.msg_size is not None else LOOPBACK_BYTES
     ser, fused = _measure_pair_latency(ctx, src, dst, nbytes)
     bw = timing.gbps(nbytes, ser.mean_region)
     if ctx.is_printer:
