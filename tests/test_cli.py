@@ -71,3 +71,9 @@ def test_main_torus_without_2d_mesh_fails(capsys):
     rc = main(["--pattern", "torus2d", "--iters", "1"])
     assert rc == 1
     assert "2-axis mesh" in capsys.readouterr().err
+
+def test_main_ulysses_attention_end_to_end(capsys):
+    rc = main(["--pattern", "ulysses_attention", "--iters", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "ulysses_attention" in out and "TFLOP/s" in out

@@ -85,3 +85,21 @@ def test_tiny_config_respects_mesh_divisibility():
     assert tiny.batch % 2 == 0
     assert tiny.seq % 2 == 0
     assert tiny.heads % 2 == 0
+
+def test_forward_flash_path_matches_jnp(rt):
+    # --flash / ModelConfig(use_flash=True) must produce the same
+    # forward as the jnp path (Pallas kernel in interpret mode on CPU).
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from tpu_p2p.models import ring_transformer as M
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "sp"))
+    kw = dict(batch=2, seq=64, heads=4, head_dim=8, dtype="float32")
+    cfg_f = M.ModelConfig(use_flash=True, **kw)
+    cfg_j = M.ModelConfig(use_flash=False, **kw)
+    params = M.place_params(M.init_params(cfg_f), mesh)
+    x, _ = M.example_batch(cfg_f, mesh)
+    got = np.asarray(M.make_forward(mesh, cfg_f)(params, x))
+    want = np.asarray(M.make_forward(mesh, cfg_j)(params, x))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)

@@ -165,3 +165,34 @@ def test_differential_mode_pairwise(rt, capsys):
     run_pairwise(ctx)
     out = capsys.readouterr().out
     assert "# pairwise uni-dir 4KiB differential" in out
+
+
+def test_sp_attention_uses_axis_size_not_device_count(capsys):
+    # On a 4x2 mesh the SP collectives span only the first axis (size
+    # 4): sizing, divisibility, and byte accounting must use 4, not 8.
+    from tpu_p2p.cli import main
+
+    rc = main([
+        "--pattern", "ulysses_attention", "--iters", "2",
+        "--mesh-shape", "4x2",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "over 4 devices" in out
+    # default heads: smallest multiple of 4 >= 8 is 8; bytes per
+    # reshard = B*H*T*D*itemsize/n * (n-1)/n with n=4
+    from tpu_p2p.ops.ulysses import a2a_bytes_per_reshard
+    import jax.numpy as jnp
+
+    want = a2a_bytes_per_reshard(8, 8, 512, 64, 4, jnp.bfloat16)
+    assert f"{want} B/reshard" in out
+
+
+def test_ulysses_workload_odd_device_count_defaults_divisible(capsys):
+    from tpu_p2p.cli import main
+
+    rc = main([
+        "--pattern", "ulysses_attention", "--iters", "1", "--num-devices", "3",
+    ])
+    assert rc == 0
+    assert "H9" in capsys.readouterr().out  # 3 * ceil(8/3) = 9 heads

@@ -76,6 +76,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip cells already recorded in --jsonl")
     p.add_argument("--profile-dir", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run")
+    p.add_argument("--flash", action="store_true",
+                   help="ring_attention: use the Pallas flash kernel for the "
+                        "block-accumulate step (forward-only fast path)")
     p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
                    help="testing: force CPU platform with N simulated devices")
     p.add_argument("--list-devices", action="store_true",
@@ -110,6 +113,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         jsonl=args.jsonl,
         resume=args.resume,
         profile_dir=args.profile_dir,
+        use_flash=args.flash,
     )
 
 

@@ -69,6 +69,7 @@ PATTERNS = (
     "torus2d",       # both mesh axes (configs[4])
     "latency",       # 8B p50 send/recv latency (BASELINE metric)
     "ring_attention",  # flagship SP workload over the same transport
+    "ulysses_attention",  # all_to_all SP counterpart (configs[3] transport)
 )
 
 MODES = ("serialized", "fused", "differential")  # SURVEY.md §7 hard part (c);
@@ -103,6 +104,8 @@ class BenchConfig:
     resume: bool = False  # skip cells already present in jsonl
     seed: int = 0
     profile_dir: Optional[str] = None  # jax.profiler trace output
+    use_flash: bool = False  # Pallas flash kernel on the ring_attention
+    # forward path (no VJP — benchmark/inference only)
 
     def __post_init__(self) -> None:
         if self.pattern not in PATTERNS:

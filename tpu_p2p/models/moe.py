@@ -1,0 +1,178 @@
+"""Mixture-of-Experts layer with expert parallelism over ``all_to_all``.
+
+SURVEY.md §2.3's expert-parallelism row: the reference has no model
+code, but EP's transport is precisely the ``all_to_all`` collective the
+benchmark measures (BASELINE.json configs[3]). This module supplies the
+compute side — a Switch-style top-1-routed MoE FFN whose expert shards
+live on an ``ep`` mesh axis — so the framework demonstrates the real
+dispatch→compute→combine pattern, not just the raw collective.
+
+TPU-first design notes:
+
+- **Static shapes everywhere.** Routing is expressed as dense one-hot
+  dispatch/combine einsums against a fixed per-expert capacity ``C``
+  (tokens over capacity are dropped, their output is zero and the
+  caller's residual carries them) — no gather/scatter with
+  data-dependent shapes, which XLA cannot tile onto the MXU.
+- **Dispatch** builds ``[E, C, D]`` buffers; one tiled ``all_to_all``
+  along ``ep`` (split over the expert dim, concat over capacity) lands
+  each device's share ``[E/n, n·C, D]`` on the expert's owner; the
+  expert FFN is a batched einsum over the local expert dim; a second
+  ``all_to_all`` inverts the reshard; a combine einsum scatters expert
+  outputs back to token positions with their gate weights.
+- The routing math (cumsum-based capacity positions) runs in float32;
+  expert matmuls stay in the payload dtype (bf16 on TPU) with float32
+  accumulation via ``preferred_element_type``.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, jax.Array]
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    """Global shapes. ``num_experts`` must divide by the ep axis size."""
+
+    d_model: int = 64
+    d_ff: int = 128
+    num_experts: int = 8
+    capacity_factor: float = 2.0
+
+    def capacity(self, tokens: int) -> int:
+        """Per-expert slot count for ``tokens`` routed tokens."""
+        return max(1, math.ceil(tokens * self.capacity_factor / self.num_experts))
+
+
+def init_moe_params(cfg: MoEConfig, seed: int = 0, dtype=jnp.float32) -> Params:
+    rng = np.random.default_rng(seed)
+    e, d, f = cfg.num_experts, cfg.d_model, cfg.d_ff
+
+    def w(*shape, fan_in):
+        return jnp.asarray(rng.standard_normal(shape) / math.sqrt(fan_in),
+                           dtype=dtype)
+
+    return {
+        "router": w(d, e, fan_in=d),
+        "w1": w(e, d, f, fan_in=d),
+        "w2": w(e, f, d, fan_in=f),
+    }
+
+
+def _route_top1(x, router_w, num_experts: int, capacity: int):
+    """Switch-style top-1 routing with static capacity.
+
+    Returns ``(dispatch [G,E,C] bool-ish, combine [G,E,C] f32)`` for
+    ``G`` local tokens: dispatch places each kept token in its expert's
+    next free slot; combine carries the router's softmax gate weight.
+    """
+    logits = jnp.einsum("gd,de->ge", x.astype(jnp.float32),
+                        router_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                      # [G]
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)  # [G,E]
+    # Slot index of each token within its expert (first-come order).
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot       # [G,E]
+    keep = (pos < capacity) * onehot                         # drops overflow
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+    dispatch = keep[..., None] * slot                        # [G,E,C]
+    gate = jnp.sum(probs * keep, axis=-1, keepdims=True)     # [G,1]
+    combine = dispatch * gate[..., None]
+    return dispatch, combine
+
+
+def moe_layer_local(params: Params, x, cfg: MoEConfig, ep_axis=None):
+    """Per-shard MoE FFN body — call inside ``shard_map``.
+
+    ``x``: local tokens ``[G, D]``. With ``ep_axis`` set, each device
+    holds ``E/n`` experts' weights (``params["w1"]/["w2"]`` leading dim
+    ep-sharded; the router is replicated) and dispatch crosses the mesh
+    via two ``all_to_all``\\ s. With ``ep_axis=None`` all experts are
+    local and the all_to_alls vanish — the single-device oracle.
+    """
+    n = jax.lax.axis_size(ep_axis) if ep_axis is not None else 1
+    g, d = x.shape
+    e = cfg.num_experts
+    cap = cfg.capacity(g)
+    e_local = params["w1"].shape[0]
+    if e_local * n != e:
+        raise ValueError(
+            f"expert shards ({e_local}) × ep size ({n}) != experts ({e})"
+        )
+
+    dispatch, combine = _route_top1(x, params["router"], e, cap)
+    # Gather routed tokens into per-expert slots: [E, C, D].
+    slots = jnp.einsum("gec,gd->ecd", dispatch.astype(x.dtype), x,
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+    if ep_axis is not None and n > 1:
+        # Ship each expert's slots to its owner: [E,C,D] → [E/n, n·C, D].
+        slots = jax.lax.all_to_all(slots, ep_axis, split_axis=0,
+                                   concat_axis=1, tiled=True)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", slots, params["w1"],
+                               preferred_element_type=jnp.float32))
+    y = jnp.einsum("ecf,efd->ecd", h.astype(x.dtype), params["w2"],
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    if ep_axis is not None and n > 1:
+        # Inverse reshard: [E/n, n·C, D] → [E, C, D] back at the source.
+        y = jax.lax.all_to_all(y, ep_axis, split_axis=1, concat_axis=0,
+                               tiled=True)
+    # Scatter expert outputs back to token positions, gate-weighted.
+    return jnp.einsum("gec,ecd->gd", combine.astype(y.dtype), y,
+                      preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def moe_reference(params: Params, x, cfg: MoEConfig):
+    """Capacity-free oracle: every token through its top-1 expert.
+
+    Computes all experts densely for every token and selects — O(G·E)
+    compute, fine at test scale. Matches ``moe_layer_local`` exactly
+    whenever capacity is large enough that nothing drops.
+    """
+    logits = jnp.einsum("gd,de->ge", x.astype(jnp.float32),
+                        params["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.max(probs, axis=-1)
+    h = jax.nn.gelu(jnp.einsum("gd,edf->egf", x, params["w1"],
+                               preferred_element_type=jnp.float32))
+    y = jnp.einsum("egf,efd->egd", h.astype(x.dtype), params["w2"],
+                   preferred_element_type=jnp.float32)
+    sel = jnp.take_along_axis(y, expert[None, :, None], axis=0)[0]
+    return (sel * gate[:, None]).astype(x.dtype)
+
+
+def ep_param_specs(mesh):
+    """PartitionSpecs for the MoE params on a mesh with an ``ep`` axis:
+    expert-dim sharded weights, replicated router."""
+    from jax.sharding import PartitionSpec as P
+
+    ep = "ep" if "ep" in mesh.axis_names else None
+    return {"router": P(None, None), "w1": P(ep, None, None),
+            "w2": P(ep, None, None)}
+
+
+def make_moe_layer(mesh, cfg: MoEConfig):
+    """Jitted MoE layer over ``mesh``: global tokens ``[G, D]`` sharded
+    over ``ep`` (tokens data-parallel over the same axis the experts
+    shard over — the standard EP layout), expert weights ep-sharded."""
+    from jax.sharding import PartitionSpec as P
+
+    ep = "ep" if "ep" in mesh.axis_names else None
+    x_spec = P(ep, None)
+
+    def f(params, x):
+        return moe_layer_local(params, x, cfg, ep_axis=ep)
+
+    return jax.jit(
+        jax.shard_map(f, mesh=mesh,
+                      in_specs=(ep_param_specs(mesh), x_spec),
+                      out_specs=x_spec)
+    )

@@ -61,6 +61,8 @@ class ModelConfig:
     mlp_mult: int = 4
     causal: bool = True
     dtype: str = "bfloat16"
+    use_flash: bool = False  # Pallas flash carry step on the forward
+    # path (no VJP — make_train_step always uses the jnp path)
 
     @property
     def model_dim(self) -> int:
@@ -115,14 +117,17 @@ def data_spec(mesh: Mesh) -> P:
     return P(_axis(mesh, "dp"), _axis(mesh, "sp"), None)
 
 
-def _forward(params, x, cfg: ModelConfig, sp, tp):
+def _forward(params, x, cfg: ModelConfig, sp, tp, allow_flash=True):
     """Local-shard forward. x: [B_loc, T_loc, Dm]; head params hold
     this tp rank's head slice."""
     q = jnp.einsum("btm,hmd->bhtd", x, params["wq"])
     k = jnp.einsum("btm,hmd->bhtd", x, params["wk"])
     v = jnp.einsum("btm,hmd->bhtd", x, params["wv"])
     if sp is not None:
-        a = ring_attention_local(q, k, v, sp, causal=cfg.causal)
+        a = ring_attention_local(
+            q, k, v, sp, causal=cfg.causal,
+            use_flash=cfg.use_flash and allow_flash,
+        )
     else:
         a = dense_attention(q, k, v, causal=cfg.causal)
     y = jnp.einsum("bhtd,hdm->btm", a, params["wo"])
@@ -139,10 +144,13 @@ def make_forward(mesh: Mesh, cfg: ModelConfig):
     def f(params, x):
         return _forward(params, x, cfg, sp, tp)
 
+    # check_vma=False on the flash path — same JAX varying-manual-axes
+    # workaround as ops.attention.ring_attention.
     sm = jax.shard_map(
         f, mesh=mesh,
         in_specs=(param_specs(mesh), data_spec(mesh)),
         out_specs=data_spec(mesh),
+        check_vma=not cfg.use_flash,
     )
     return jax.jit(sm)
 
@@ -156,7 +164,8 @@ def make_train_step(mesh: Mesh, cfg: ModelConfig, lr: float = 1e-3):
 
     def step(params, x, target):
         def local_loss(p):
-            out = _forward(p, x, cfg, sp, tp)
+            # allow_flash=False: the Pallas carry step has no VJP.
+            out = _forward(p, x, cfg, sp, tp, allow_flash=False)
             return jnp.sum(
                 (out.astype(jnp.float32) - target.astype(jnp.float32)) ** 2
             )

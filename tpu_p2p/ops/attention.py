@@ -51,6 +51,17 @@ def dense_attention(q, k, v, *, causal: bool = False):
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 
+def finalize(o, m, l, dtype):
+    """Normalize a streaming-softmax carry into attention output.
+
+    Shared by the jnp ring path and the Pallas flash path so the
+    fully-masked-row policy (l==0 rows → 0) lives in exactly one place.
+    """
+    del m
+    safe = jnp.where(l == 0.0, 1.0, l)
+    return (o / safe[..., None]).astype(dtype)
+
+
 def _block_scores(q, k, scale):
     return jnp.einsum("bhqd,bhkd->bhqk", q, k,
                       preferred_element_type=jnp.float32) * scale
@@ -136,9 +147,8 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
         )
 
     # Fully-masked rows (can't happen for causal ring queries, but keep
-    # the kernel total): guard l == 0.
-    l = jnp.where(l == 0.0, 1.0, l)
-    return (o / l[..., None]).astype(q.dtype)
+    # the kernel total): finalize guards l == 0.
+    return finalize(o, m, l, q.dtype)
 
 
 @functools.lru_cache(maxsize=None)

@@ -184,11 +184,8 @@ def zero_carry(bh: int, t: int, d: int) -> Tuple[jax.Array, jax.Array, jax.Array
     )
 
 
-def finalize(o, m, l, dtype):
-    """Normalize the carry into attention output (l==0 rows → 0)."""
-    del m
-    safe = jnp.where(l == 0.0, 1.0, l)
-    return (o / safe[..., None]).astype(dtype)
+from tpu_p2p.ops.attention import finalize  # noqa: E402 — shared
+# carry-normalization (l==0 policy lives in ops.attention)
 
 
 def flash_carry_block(q, k, v, o, m, l, q_off, k_off, *,

@@ -1,0 +1,104 @@
+"""Ulysses attention — all_to_all sequence parallelism.
+
+SURVEY.md §2.3/§5 name the two sequence-parallel families whose
+transports this framework measures: ring attention (shift-by-1
+``ppermute`` — :mod:`tpu_p2p.ops.attention`) and **Ulysses**
+(head↔sequence ``all_to_all`` — this module; the transport is the
+``all_to_all`` workload / BASELINE.json configs[3]). The reference has
+no model code (sole source file ``/root/reference/p2p_matrix.cc``);
+this exists so the framework demonstrates the *composite*
+communication+compute pattern, not just the raw collective.
+
+Mechanism (DeepSpeed-Ulysses resharding, expressed TPU-first):
+
+- Input: Q, K, V sequence-sharded — each device holds
+  ``[B, H, T/n, D]`` with the *full* head dim.
+- One tiled ``all_to_all`` per tensor flips the sharded dim:
+  heads scatter, sequence gathers → ``[B, H/n, T, D]``.
+- Attention is then computed **densely and locally** — every device
+  sees the entire sequence for its head slice, so no online-softmax
+  accumulation, no per-hop masking, one big MXU-friendly matmul pair.
+- A second ``all_to_all`` flips back to sequence sharding.
+
+Trade-off vs ring: Ulysses moves ``3 + 1`` tensor reshards of
+``O(B·T·H·D / n)`` bytes per device through all-to-all traffic but
+keeps the compute as one dense block; ring moves ``n-1`` KV block
+rotations over neighbor links and streams the softmax. Which wins is a
+fabric property — exactly what the ``all_to_all`` vs ``ring`` workload
+matrices measure. Constraint: ``H % n == 0`` (ring instead shards T
+only, so it has no head-count constraint).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_p2p.ops.attention import dense_attention
+
+
+def _heads_to_seq(x, axis_name: str):
+    """[B, H, T/n, D] → [B, H/n, T, D]: scatter heads, gather sequence."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def _seq_to_heads(x, axis_name: str):
+    """[B, H/n, T, D] → [B, H, T/n, D]: the inverse reshard."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def ulysses_attention_local(q, k, v, axis_name: str, *, causal: bool = False):
+    """Per-shard Ulysses attention body — call inside ``shard_map``.
+
+    ``q, k, v``: local blocks ``[B, H, T_local, D]``, sequence sharded
+    along ``axis_name``; requires ``H`` divisible by the axis size.
+    Four ``all_to_all`` reshards (three in, one out) bracket one dense
+    local attention over the full sequence.
+    """
+    n = jax.lax.axis_size(axis_name)
+    h = q.shape[1]
+    if h % n:
+        raise ValueError(
+            f"Ulysses needs heads ({h}) divisible by axis size ({n}); "
+            "use ring attention for head counts below the mesh axis"
+        )
+    qh = _heads_to_seq(q, axis_name)
+    kh = _heads_to_seq(k, axis_name)
+    vh = _heads_to_seq(v, axis_name)
+    # Full sequence is local now, so the plain causal mask is correct —
+    # no global-position bookkeeping as in the ring's block masking.
+    ah = dense_attention(qh, kh, vh, causal=causal)
+    return _seq_to_heads(ah, axis_name)
+
+
+@functools.lru_cache(maxsize=None)
+def ulysses_attention(mesh: Mesh, axis: str, causal: bool = False):
+    """Jitted global Ulysses attention over ``mesh``.
+
+    Takes global ``[B, H, T, D]`` arrays with ``T`` sharded along
+    ``axis`` — the same calling convention as
+    :func:`tpu_p2p.ops.attention.ring_attention`, so the two SP
+    strategies are drop-in interchangeable.
+    """
+    spec = P(None, None, axis, None)
+
+    def f(q, k, v):
+        return ulysses_attention_local(q, k, v, axis, causal=causal)
+
+    return jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)
+    )
+
+
+def a2a_bytes_per_reshard(b: int, h: int, t: int, d: int, n: int, dtype) -> int:
+    """Bytes each device exchanges per tensor reshard: all but the
+    ``1/n`` chunk it keeps of its ``B·(H/n)·(T/n)·D``-sized send."""
+    import numpy as np
+
+    local = b * h * t * d * np.dtype(dtype).itemsize // n
+    return local * (n - 1) // n

@@ -12,4 +12,5 @@ from tpu_p2p.workloads import (  # noqa: F401  (registration side effects)
     ring,
     ring_attn,
     torus,
+    ulysses_attn,
 )

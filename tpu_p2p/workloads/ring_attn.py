@@ -13,39 +13,25 @@ from __future__ import annotations
 
 import sys
 
-import jax
-import numpy as np
-
 from tpu_p2p.models.ring_transformer import ModelConfig
 from tpu_p2p.ops import attention as A
 from tpu_p2p.utils import timing
 from tpu_p2p.workloads.base import WorkloadContext, cell_record, workload
+from tpu_p2p.workloads.sp_common import bench_sp_attention
 
 
 @workload("ring_attention")
 def run_ring_attention(ctx: WorkloadContext, model_cfg: ModelConfig = None) -> dict:
-    rt, cfg = ctx.rt, ctx.cfg
-    n = rt.num_devices
-    axis = rt.mesh.axis_names[0]
-    mc = model_cfg or ModelConfig(seq=max(512, 64 * n))
-    rng = np.random.default_rng(cfg.seed)
-    shape = (mc.batch, mc.heads, mc.seq, mc.head_dim)
-    sharding = A.attention_sharding(rt.mesh, axis)
-    q, k, v = (
-        jax.device_put(
-            np.asarray(rng.standard_normal(shape), dtype=mc.dtype), sharding
-        )
-        for _ in range(3)
+    cfg = ctx.cfg
+    mc, axis, n, s, tflops = bench_sp_attention(
+        ctx, model_cfg, default_heads=lambda n: 8,
+        build_fn=lambda mesh, ax, m: A.ring_attention(
+            mesh, ax, m.causal, use_flash=cfg.use_flash
+        ),
     )
-    fn = A.ring_attention(rt.mesh, axis, mc.causal)
-    s = timing.measure_serialized(
-        lambda args: fn(*args), (q, k, v), cfg.iters,
-        warmup=max(1, cfg.warmup), timeout_s=cfg.timeout_s, barrier=rt.barrier,
+    hop_bytes = A.kv_bytes_per_hop(
+        mc.batch, mc.heads, mc.seq // n, mc.head_dim, mc.dtype
     )
-    flops = A.flops_per_step(mc.batch, mc.heads, mc.seq, mc.head_dim, causal=mc.causal)
-    hop_bytes = A.kv_bytes_per_hop(mc.batch, mc.heads, mc.seq // n, mc.head_dim, mc.dtype)
-    step_s = s.p50
-    tflops = flops / step_s / 1e12 if step_s == step_s else float("nan")
     comm_gbps = timing.gbps(hop_bytes * (n - 1), s.mean_region)
     if ctx.is_printer:
         sys.stdout.write(

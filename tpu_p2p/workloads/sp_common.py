@@ -1,0 +1,69 @@
+"""Shared setup/measurement for the sequence-parallel attention
+workloads (ring_attention / ulysses_attention).
+
+Both workloads measure the same thing — one SP attention step over the
+first mesh axis — and differ only in transport (ring ``ppermute`` vs
+head↔seq ``all_to_all``), so the QKV staging, timing, and FLOPs
+accounting live here once. All sizing uses the **sharded axis size**
+(``mesh.shape[axis]``), not the total device count: on a multi-axis
+mesh (e.g. ``--mesh-shape 4x2``) the collective only spans the first
+axis.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Tuple
+
+import jax
+import numpy as np
+
+from tpu_p2p.models.ring_transformer import ModelConfig
+from tpu_p2p.ops import attention as A
+from tpu_p2p.utils import timing
+from tpu_p2p.workloads.base import WorkloadContext
+
+
+def bench_sp_attention(
+    ctx: WorkloadContext,
+    model_cfg: Optional[ModelConfig],
+    default_heads: Callable[[int], int],
+    build_fn: Callable,  # (mesh, axis, mc) -> jitted (q, k, v) -> out
+) -> Tuple[ModelConfig, str, int, timing.Samples, float]:
+    """Stage sharded QKV, run ``build_fn``'s attention under the
+    serialized timer, and return ``(mc, axis, axis_size, samples,
+    tflops)``."""
+    rt, cfg = ctx.rt, ctx.cfg
+    axis = rt.mesh.axis_names[0]
+    n = rt.mesh.shape[axis]
+    # Default seq: >= 512, always a multiple of the sharded axis size
+    # (any axis size, not just powers of two) — same invariant as the
+    # head count, so both derive from heads_multiple_of.
+    seq = 64 * heads_multiple_of(n)
+    mc = model_cfg or ModelConfig(seq=seq, heads=default_heads(n))
+    rng = np.random.default_rng(cfg.seed)
+    shape = (mc.batch, mc.heads, mc.seq, mc.head_dim)
+    sharding = A.attention_sharding(rt.mesh, axis)
+    q, k, v = (
+        jax.device_put(
+            np.asarray(rng.standard_normal(shape), dtype=mc.dtype), sharding
+        )
+        for _ in range(3)
+    )
+    fn = build_fn(rt.mesh, axis, mc)
+    s = timing.measure_serialized(
+        lambda args: fn(*args), (q, k, v), cfg.iters,
+        warmup=max(1, cfg.warmup), timeout_s=cfg.timeout_s, barrier=rt.barrier,
+    )
+    flops = A.flops_per_step(
+        mc.batch, mc.heads, mc.seq, mc.head_dim, causal=mc.causal
+    )
+    step_s = s.p50
+    tflops = flops / step_s / 1e12 if step_s == step_s else float("nan")
+    return mc, axis, n, s, tflops
+
+
+def heads_multiple_of(n: int, target: int = 8) -> int:
+    """Smallest multiple of ``n`` that is >= ``target`` — a head count
+    that always satisfies Ulysses' divisibility constraint."""
+    return n * math.ceil(target / n)
