@@ -1,0 +1,109 @@
+"""Flagship 5-axis model: every mesh factorization must produce the
+same numbers as the single-device run (SURVEY.md §4 oracle strategy —
+this is the test that pins dp/pp/sp/tp/ep composition correctness)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpu_p2p.models import flagship as F
+
+
+def _cfg():
+    # Divisible by every axis assignment used below; capacity_factor ==
+    # num_experts → no MoE drops, so sharded == unsharded exactly.
+    return F.FlagshipConfig(
+        batch=8, seq=32, heads=4, head_dim=8, stages=2, microbatches=2,
+        num_experts=4, capacity_factor=4.0, dtype="float32",
+    )
+
+
+def _mesh(shape):
+    n = int(np.prod(shape))
+    return Mesh(np.array(jax.devices()[:n]).reshape(shape), F.AXES)
+
+
+def _oracle(cfg, params, x):
+    mesh1 = _mesh((1, 1, 1, 1, 1))
+    p1 = F.place_flagship_params(params, mesh1)
+    return np.asarray(F.make_flagship_forward(mesh1, cfg)(p1, x))
+
+
+MESHES = [
+    (2, 2, 2, 1, 1),  # dp, pp, sp
+    (1, 2, 1, 2, 2),  # pp, tp, ep
+    (2, 1, 2, 1, 2),  # dp, sp, ep
+    (1, 1, 2, 2, 2),  # sp, tp, ep
+]
+
+
+@pytest.mark.parametrize("shape", MESHES)
+def test_flagship_forward_matches_single_device(shape):
+    cfg = _cfg()
+    params = F.init_flagship_params(cfg)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(
+        rng.standard_normal((cfg.batch, cfg.seq, cfg.model_dim)),
+        dtype=jnp.float32,
+    )
+    want = _oracle(cfg, params, x)
+    mesh = _mesh(shape)
+    placed = F.place_flagship_params(params, mesh)
+    got = np.asarray(F.make_flagship_forward(mesh, cfg)(placed, x))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_flagship_train_step_matches_single_device():
+    cfg = _cfg()
+    params = F.init_flagship_params(cfg)
+    mesh1 = _mesh((1, 1, 1, 1, 1))
+    mesh = _mesh((2, 2, 2, 1, 1))
+    x, t = F.flagship_example_batch(cfg)
+    p1 = F.place_flagship_params(params, mesh1)
+    pN = F.place_flagship_params(params, mesh)
+    new1, loss1 = F.make_flagship_train_step(mesh1, cfg)(p1, x, t)
+    newN, lossN = F.make_flagship_train_step(mesh, cfg)(pN, x, t)
+    assert abs(float(loss1) - float(lossN)) < 1e-4 * max(1.0, abs(float(loss1)))
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(new1[k]), np.asarray(newN[k]),
+            atol=2e-4, rtol=2e-4, err_msg=k,
+        )
+
+
+def test_flagship_train_step_decreases_loss():
+    cfg = _cfg()
+    mesh = _mesh((1, 2, 2, 1, 2))
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    x, t = F.flagship_example_batch(cfg, mesh)
+    step = F.make_flagship_train_step(mesh, cfg, lr=5e-2)
+    losses = []
+    for _ in range(4):
+        params, loss = step(params, x, t)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_build_mesh_factorization():
+    m8 = F.build_mesh(8)
+    assert m8.axis_names == F.AXES
+    assert int(np.prod(m8.devices.shape)) == 8
+    m1 = F.build_mesh(1)
+    assert m1.devices.shape == (1, 1, 1, 1, 1)
+    m6 = F.build_mesh(6)
+    assert int(np.prod(m6.devices.shape)) == 6
+
+
+def test_flagship_bad_divisibility_raises():
+    cfg = F.FlagshipConfig(batch=8, seq=32, heads=4, head_dim=8,
+                           stages=3, microbatches=2, num_experts=4,
+                           dtype="float32")
+    mesh = _mesh((1, 2, 1, 1, 1))  # stages=3 won't split over pp=2
+    with pytest.raises(Exception, match="divide|divisible"):
+        # Fails at placement (stage dim 3 won't shard over pp=2) or,
+        # for configs that place, inside the forward's own check.
+        params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+        x, _ = F.flagship_example_batch(cfg)
+        F.make_flagship_forward(mesh, cfg)(params, x)

@@ -29,10 +29,12 @@ def test_dryrun_multichip(n, capsys):
     assert "dryrun_multichip OK" in capsys.readouterr().out
 
 
-def test_mesh_axes_factoring():
-    mod = _load()
-    shape, names = mod._mesh_axes_for(8)
-    assert int(__import__("numpy").prod(shape)) == 8
-    assert set(names) <= {"dp", "sp", "tp"}
-    shape, names = mod._mesh_axes_for(6)
-    assert int(__import__("numpy").prod(shape)) == 6
+def test_dryrun_mesh_carries_all_five_axes():
+    # The driver contract asks for real dp/pp/sp/tp/ep shardings: the
+    # dryrun mesh must carry all five named axes (size-1 axes still
+    # compile their collectives into the program). Checked via the
+    # mesh builder — dryrun_multichip itself is exercised above.
+    from tpu_p2p.models.flagship import AXES, build_mesh
+
+    mesh = build_mesh(8)
+    assert mesh.axis_names == AXES == ("dp", "pp", "sp", "tp", "ep")

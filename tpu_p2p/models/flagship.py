@@ -1,0 +1,317 @@
+"""Flagship model: every parallelism axis in one jitted training step.
+
+The capstone of SURVEY.md §2.3's parallelism inventory: a MoE
+transformer whose single compiled train step composes all five
+strategies over one ``(dp, pp, sp, tp, ep)`` mesh —
+
+- **dp** (data): batch sharded over ``dp`` (jointly with ``ep``);
+  gradient reductions happen implicitly in ``shard_map`` autodiff.
+- **pp** (pipeline): stage-major params sharded over ``pp``; GPipe
+  microbatch schedule from :mod:`tpu_p2p.models.pipeline`, activations
+  hopping stage→stage+1 via ``ppermute``.
+- **sp** (sequence): sequence sharded; ring attention rotates KV via
+  shift-by-1 ``ppermute`` (:mod:`tpu_p2p.ops.attention`).
+- **tp** (tensor): attention heads Megatron-sharded; output partial
+  sums join via ``psum`` over ``tp``.
+- **ep** (expert): the FFN is a top-1 MoE
+  (:mod:`tpu_p2p.models.moe`); tokens shard over ``ep`` (batch-wise,
+  jointly with dp), experts live on their ``ep`` rank, dispatch
+  crosses the mesh via two ``all_to_all``\\ s.
+
+Any axis may have size 1 — the collective machinery still compiles
+(``ppermute``/``all_to_all``/``psum`` over a trivial axis), so the
+same program scales from one chip to a pod by reshaping the mesh.
+This is the model behind ``__graft_entry__.entry`` /
+``dryrun_multichip``.
+
+The reference repo has no model code (sole source
+``/root/reference/p2p_matrix.cc``); this module exists because the
+framework's transport benchmarks (pairwise/ring/all_to_all matrices)
+are only half the story — the judge of a fabric is the composite
+pattern a real sharded train step drives through it.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_p2p.models.moe import MoEConfig, moe_layer_local
+from tpu_p2p.models.pipeline import pipeline_apply_local
+from tpu_p2p.ops.attention import dense_attention, ring_attention_local
+
+Params = Dict[str, jax.Array]
+
+AXES = ("dp", "pp", "sp", "tp", "ep")
+
+
+@dataclass(frozen=True)
+class FlagshipConfig:
+    """Global shapes; every dim must divide by its mesh axis size."""
+
+    batch: int = 8
+    seq: int = 256
+    heads: int = 8
+    head_dim: int = 32
+    stages: int = 2          # total pipeline stages (multiple of pp size)
+    microbatches: int = 2
+    num_experts: int = 4
+    capacity_factor: float = 2.0
+    moe_mult: int = 2        # expert FFN width = moe_mult * model_dim
+    causal: bool = True
+    dtype: str = "float32"
+
+    @property
+    def model_dim(self) -> int:
+        return self.heads * self.head_dim
+
+    def moe(self) -> MoEConfig:
+        return MoEConfig(
+            d_model=self.model_dim, d_ff=self.moe_mult * self.model_dim,
+            num_experts=self.num_experts,
+            capacity_factor=self.capacity_factor,
+        )
+
+    def tiny(self, mesh: Mesh) -> "FlagshipConfig":
+        """Shrink to dryrun scale while keeping every axis shardable."""
+        ax = dict(zip(mesh.axis_names, mesh.devices.shape))
+        tp, sp, pp = ax.get("tp", 1), ax.get("sp", 1), ax.get("pp", 1)
+        dpep = ax.get("dp", 1) * ax.get("ep", 1)
+        return replace(
+            self,
+            batch=2 * dpep * self.microbatches,
+            seq=16 * sp,
+            heads=2 * tp,
+            head_dim=8,
+            stages=pp,
+            num_experts=2 * ax.get("ep", 1),
+            capacity_factor=float(2 * ax.get("ep", 1)),  # no-drop capacity
+        )
+
+
+def _axis(mesh: Mesh, name: str):
+    return name if name in mesh.axis_names else None
+
+
+def init_flagship_params(cfg: FlagshipConfig, seed: int = 0) -> Params:
+    rng = np.random.default_rng(seed)
+    s, h = cfg.stages, cfg.heads
+    dm, dh = cfg.model_dim, cfg.head_dim
+    e, f = cfg.num_experts, cfg.moe_mult * cfg.model_dim
+    dtype = jnp.dtype(cfg.dtype)
+
+    def w(*shape, fan_in):
+        return jnp.asarray(rng.standard_normal(shape) / math.sqrt(fan_in),
+                           dtype=dtype)
+
+    return {
+        "wq": w(s, h, dm, dh, fan_in=dm),
+        "wk": w(s, h, dm, dh, fan_in=dm),
+        "wv": w(s, h, dm, dh, fan_in=dm),
+        "wo": w(s, h, dh, dm, fan_in=dh),
+        "router": w(s, dm, e, fan_in=dm),
+        "we1": w(s, e, dm, f, fan_in=dm),
+        "we2": w(s, e, f, dm, fan_in=f),
+    }
+
+
+def flagship_param_specs(mesh: Mesh) -> Dict[str, P]:
+    pp, tp, ep = _axis(mesh, "pp"), _axis(mesh, "tp"), _axis(mesh, "ep")
+    return {
+        "wq": P(pp, tp, None, None),
+        "wk": P(pp, tp, None, None),
+        "wv": P(pp, tp, None, None),
+        "wo": P(pp, tp, None, None),
+        "router": P(pp, None, None),
+        "we1": P(pp, ep, None, None),
+        "we2": P(pp, ep, None, None),
+    }
+
+
+def flagship_data_spec(mesh: Mesh) -> P:
+    """Batch sharded jointly over (dp, ep); sequence over sp."""
+    dp, ep, sp = _axis(mesh, "dp"), _axis(mesh, "ep"), _axis(mesh, "sp")
+    batch_axes = tuple(a for a in (dp, ep) if a is not None)
+    return P(batch_axes if batch_axes else None, sp, None)
+
+
+def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
+    """One transformer block: ring attention + MoE FFN, both residual.
+
+    ``sub_params`` leaves are one stage's slice (no stage dim).
+    ``x``: local shard ``[mb_loc, T_loc, Dm]``. Zero input → zero
+    output, which keeps pipeline bubble ticks inert.
+    """
+    q = jnp.einsum("btm,hmd->bhtd", x, sub_params["wq"])
+    k = jnp.einsum("btm,hmd->bhtd", x, sub_params["wk"])
+    v = jnp.einsum("btm,hmd->bhtd", x, sub_params["wv"])
+    if sp is not None:
+        a = ring_attention_local(q, k, v, sp, causal=cfg.causal)
+    else:
+        a = dense_attention(q, k, v, causal=cfg.causal)
+    y = jnp.einsum("bhtd,hdm->btm", a, sub_params["wo"])
+    if tp is not None:
+        y = jax.lax.psum(y, tp)  # Megatron join of head shards
+    x = x + y
+    # MoE FFN over flattened local tokens.
+    moe_params = {k2: sub_params[k2] for k2 in ("router",)}
+    moe_params["w1"], moe_params["w2"] = sub_params["we1"], sub_params["we2"]
+    tokens = x.reshape(-1, x.shape[-1])
+    m_out = moe_layer_local(moe_params, tokens, cfg.moe(), ep_axis=ep)
+    return x + m_out.reshape(x.shape)
+
+
+def _stage_block(stage_params: Params, x, cfg: FlagshipConfig,
+                 s_local: int, sp, tp, ep):
+    """Apply this pp rank's ``s_local`` consecutive sub-blocks."""
+    for i in range(s_local):
+        sub = {k: v[i] for k, v in stage_params.items()}
+        x = _stage_sub_block(sub, x, cfg, sp, tp, ep)
+    return x
+
+
+def _pipeline_schedule(stage_params, x_mb, cfg, s_local, pp, sp, tp, ep):
+    """GPipe ticks over the pp axis — delegates to
+    :func:`tpu_p2p.models.pipeline.pipeline_apply_local` with the
+    transformer stage block; ``pp=None`` runs the stages sequentially."""
+    def block_fn(params, x):
+        return _stage_block(params, x, cfg, s_local, sp, tp, ep)
+
+    if pp is None:
+        return jnp.stack(
+            [block_fn(stage_params, x_mb[i]) for i in range(x_mb.shape[0])]
+        )
+    return pipeline_apply_local(block_fn, stage_params, x_mb, pp)
+
+
+def _forward_local(params, x, cfg: FlagshipConfig, mesh_axes):
+    dp, pp, sp, tp, ep = (mesh_axes.get(a) for a in AXES)
+    del dp
+    pp_size = 1
+    if pp is not None:
+        pp_size = jax.lax.axis_size(pp)
+    if cfg.stages % pp_size:
+        raise ValueError(
+            f"stages ({cfg.stages}) must divide by pp size ({pp_size})"
+        )
+    s_local = cfg.stages // pp_size
+    b_loc = x.shape[0]
+    if b_loc % cfg.microbatches:
+        raise ValueError(
+            f"local batch {b_loc} not divisible by "
+            f"{cfg.microbatches} microbatches"
+        )
+    x_mb = x.reshape((cfg.microbatches, b_loc // cfg.microbatches)
+                     + x.shape[1:])
+    y_mb = _pipeline_schedule(params, x_mb, cfg, s_local, pp, sp, tp, ep)
+    return y_mb.reshape(x.shape)
+
+
+def _mesh_axes(mesh: Mesh) -> Dict[str, str]:
+    return {a: a for a in AXES if a in mesh.axis_names}
+
+
+def make_flagship_forward(mesh: Mesh, cfg: FlagshipConfig):
+    """Jitted forward over the 5-axis mesh: global [B, T, Dm] → same."""
+    axes = _mesh_axes(mesh)
+
+    def f(params, x):
+        return _forward_local(params, x, cfg, axes)
+
+    sm = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(flagship_param_specs(mesh), flagship_data_spec(mesh)),
+        out_specs=flagship_data_spec(mesh),
+    )
+    return jax.jit(sm)
+
+
+def make_flagship_train_step(mesh: Mesh, cfg: FlagshipConfig,
+                             lr: float = 1e-2):
+    """One jitted SGD step: forward, backward, implicit gradient
+    reductions (shard_map autodiff — see
+    :mod:`tpu_p2p.models.ring_transformer` for the accounting), update."""
+    axes = _mesh_axes(mesh)
+    n_out = cfg.batch * cfg.seq * cfg.model_dim
+
+    def step(params, x, target):
+        def local_loss(p):
+            out = _forward_local(p, x, cfg, axes)
+            return jnp.sum(
+                (out.astype(jnp.float32) - target.astype(jnp.float32)) ** 2
+            )
+
+        loss, grads = jax.value_and_grad(local_loss)(params)
+        # Sum the partial losses over every data-sharded axis; pp/tp
+        # replicas are typed replicated and count once.
+        data_axes = tuple(a for a in ("dp", "ep", "sp") if a in axes)
+        if data_axes:
+            loss = jax.lax.psum(loss, data_axes)
+        new_params = jax.tree.map(
+            lambda p, g: (p.astype(jnp.float32)
+                          - lr * g / n_out).astype(p.dtype),
+            params, grads,
+        )
+        return new_params, loss / n_out
+
+    sm = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(flagship_param_specs(mesh), flagship_data_spec(mesh),
+                  flagship_data_spec(mesh)),
+        out_specs=(flagship_param_specs(mesh), P()),
+    )
+    return jax.jit(sm)
+
+
+def place_flagship_params(params: Params, mesh: Mesh) -> Params:
+    specs = flagship_param_specs(mesh)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}
+
+
+def flagship_example_batch(cfg: FlagshipConfig, mesh: Mesh = None,
+                           seed: int = 1) -> Tuple:
+    rng = np.random.default_rng(seed)
+    dtype = jnp.dtype(cfg.dtype)
+    shape = (cfg.batch, cfg.seq, cfg.model_dim)
+    x = jnp.asarray(rng.standard_normal(shape), dtype=dtype)
+    t = jnp.asarray(rng.standard_normal(shape), dtype=dtype)
+    if mesh is not None:
+        sharding = NamedSharding(mesh, flagship_data_spec(mesh))
+        x, t = jax.device_put(x, sharding), jax.device_put(t, sharding)
+    return x, t
+
+
+def build_mesh(n_devices: int, devices=None) -> Mesh:
+    """Factor ``n_devices`` over the five named axes.
+
+    Priority order sp → dp → pp → tp → ep (sp is the flagship axis;
+    tp/ep want fast links and forgive size-1). Axes that receive no
+    factor stay size 1 — every collective still compiles, so the
+    program shape is identical from 1 chip to a pod.
+    """
+    if devices is None:
+        devices = jax.devices()
+    assert len(devices) >= n_devices, (
+        f"need {n_devices} devices, have {len(devices)}"
+    )
+    factors = []
+    m = n_devices
+    for p in (2, 3, 5, 7, 11, 13):
+        while m % p == 0:
+            factors.append(p)
+            m //= p
+    if m > 1:
+        factors.append(m)
+    dims = {a: 1 for a in AXES}
+    order = ["sp", "dp", "pp", "tp", "ep"]
+    for i, f in enumerate(sorted(factors, reverse=True)):
+        dims[order[i % len(order)]] *= f
+    shape = tuple(dims[a] for a in AXES)
+    return Mesh(np.array(devices[:n_devices]).reshape(shape), AXES)

@@ -1,0 +1,219 @@
+"""Pipeline parallelism — GPipe microbatching over ``ppermute``.
+
+SURVEY.md §2.3's pipeline-parallelism row: the reference has no model
+code, but "the communication pattern underlying PP (neighbor
+send/recv) is the benchmark's core" — the shift-by-1 ``ppermute`` edge
+set of the ``ring`` workload, minus the wraparound. This module
+supplies the compute side: a GPipe-style schedule where each device
+owns one pipeline stage and activations flow stage→stage+1 through
+``ppermute``, so the framework demonstrates PP's real
+transfer-compute interleaving, not just the bare hop.
+
+TPU-first design:
+
+- **One jitted program, no data-dependent control flow.** The whole
+  ``M + S - 1``-tick schedule (``M`` microbatches, ``S`` stages) is a
+  single ``lax.scan``; bubble ticks run the same compute on zero
+  inputs and their results are masked out — static shapes, branchless,
+  exactly what XLA wants.
+- **Stage-major params.** Every stage's weights form one array with a
+  leading stage dim sharded over ``pp``
+  (``P('pp', ...)``), so each device holds its own stage's slice and
+  the block function is identical SPMD code on every stage.
+- **Differentiable end-to-end.** ``ppermute`` has a well-defined
+  transpose (the reversed edge set), so ``jax.grad`` through the scan
+  yields exact pipeline-parallel backprop — verified against a
+  single-device oracle in tests/test_pipeline.py.
+- Outputs materialize on the last stage (others contribute zeros) and
+  are ``psum``-replicated across ``pp`` so the caller sees the full
+  ``[B, ...]`` batch everywhere — the loss is then typed replicated
+  over ``pp`` and counts once in autodiff, same accounting as the tp
+  ``psum`` in :mod:`tpu_p2p.models.ring_transformer`.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = Dict[str, jax.Array]
+
+
+@dataclass(frozen=True)
+class PipelineConfig:
+    """A stack of ``stages`` identical residual-MLP blocks."""
+
+    d_model: int = 32
+    d_ff: int = 64
+    stages: int = 4
+    microbatches: int = 4
+
+
+def init_pipeline_params(cfg: PipelineConfig, seed: int = 0,
+                         dtype=jnp.float32) -> Params:
+    rng = np.random.default_rng(seed)
+    s, d, f = cfg.stages, cfg.d_model, cfg.d_ff
+
+    def w(*shape, fan_in):
+        return jnp.asarray(rng.standard_normal(shape) / math.sqrt(fan_in),
+                           dtype=dtype)
+
+    return {"w1": w(s, d, f, fan_in=d), "w2": w(s, f, d, fan_in=f)}
+
+
+def pp_param_specs(mesh: Mesh) -> Dict[str, P]:
+    pp = "pp" if "pp" in mesh.axis_names else None
+    return {"w1": P(pp, None, None), "w2": P(pp, None, None)}
+
+
+def mlp_block(stage_params: Params, x):
+    """The per-stage compute: one residual MLP block.
+
+    ``stage_params`` leaves carry the local stage slice ``[1, ...]``
+    (squeezed here). Zero input → zero output, which is what makes the
+    masked bubble ticks harmless.
+    """
+    w1, w2 = stage_params["w1"][0], stage_params["w2"][0]
+    h = jax.nn.gelu(jnp.einsum("btd,df->btf", x,
+                               w1, preferred_element_type=jnp.float32))
+    return x + jnp.einsum("btf,fd->btd", h.astype(x.dtype), w2,
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def pipeline_apply_local(block_fn: Callable, params_local: Params, x_mb,
+                         axis: str):
+    """GPipe schedule body — call inside ``shard_map`` over ``axis``.
+
+    ``x_mb``: microbatched input ``[M, mb, T, D]``, replicated over the
+    ``pp`` axis. Returns the full output ``[M, mb, T, D]``, replicated
+    (see module docstring for the psum accounting).
+
+    Tick ``t``: stage ``s`` processes microbatch ``t - s`` (zeros
+    during fill/drain bubbles); activations hop ``s → s+1`` on the
+    no-wraparound neighbor edge set — the PP transport SURVEY.md §2.3
+    maps onto this framework's ``ring`` workload.
+    """
+    s_count = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    m = x_mb.shape[0]
+    edges = [(i, i + 1) for i in range(s_count - 1)]
+    # pcast-to-varying: the scan carry is device-varying over pp (axis_index is in
+    # the tick), so its initial value must be typed varying too.
+    zero = jax.lax.pcast(jnp.zeros_like(x_mb[0]), (axis,), to='varying')
+
+    def tick(carry, t):
+        prev_in, outputs = carry
+        # Stage 0 consumes microbatch t (zeros outside [0, M)).
+        mb_idx = jnp.clip(t, 0, m - 1)
+        feed = jnp.where((t >= 0) & (t < m),
+                         jax.lax.dynamic_index_in_dim(x_mb, mb_idx, 0,
+                                                      keepdims=False),
+                         zero)
+        x_in = jnp.where(my == 0, feed, prev_in)
+        y = block_fn(params_local, x_in)
+        # Ship to the next stage (last stage's send has no edge).
+        y_next = jax.lax.ppermute(y, axis, edges) if s_count > 1 else zero
+        # Last stage: record microbatch t - (S-1) once it's real.
+        out_t = t - (s_count - 1)
+        upd = jax.lax.dynamic_update_index_in_dim(
+            outputs, y, jnp.clip(out_t, 0, m - 1), 0
+        )
+        outputs = jnp.where((my == s_count - 1) & (out_t >= 0), upd, outputs)
+        return (y_next, outputs), None
+
+    outputs0 = jax.lax.pcast(jnp.zeros_like(x_mb), (axis,), to='varying')
+    (_, outputs), _ = jax.lax.scan(
+        tick, (zero, outputs0), jnp.arange(m + s_count - 1)
+    )
+    # Replicate the last stage's outputs to every pp rank.
+    return jax.lax.psum(outputs, axis)
+
+
+def _to_microbatches(x, m: int):
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by {m} microbatches")
+    return x.reshape((m, b // m) + x.shape[1:])
+
+
+def make_pipeline_forward(mesh: Mesh, cfg: PipelineConfig,
+                          block_fn: Callable = mlp_block):
+    """Jitted pipeline forward: global ``[B, T, D]`` in and out."""
+    pp = _check_pp_mesh(mesh, cfg)
+
+    def f(params, x):
+        x_mb = _to_microbatches(x, cfg.microbatches)
+        y_mb = pipeline_apply_local(block_fn, params, x_mb, pp)
+        return y_mb.reshape(x.shape)
+
+    sm = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(pp_param_specs(mesh), P()),
+        out_specs=P(),
+    )
+    return jax.jit(sm)
+
+
+def _check_pp_mesh(mesh: Mesh, cfg: PipelineConfig) -> str:
+    pp = "pp" if "pp" in mesh.axis_names else None
+    if pp is None:
+        raise ValueError("mesh needs a 'pp' axis for pipeline parallelism")
+    if mesh.shape[pp] != cfg.stages:
+        raise ValueError(
+            f"cfg.stages ({cfg.stages}) != pp axis size ({mesh.shape[pp]})"
+        )
+    return pp
+
+
+def make_pipeline_train_step(mesh: Mesh, cfg: PipelineConfig,
+                             block_fn: Callable = mlp_block, lr: float = 1e-2):
+    """One jitted SGD step through the pipeline schedule."""
+    pp = _check_pp_mesh(mesh, cfg)
+
+    def step(params, x, target):
+        def local_loss(p):
+            x_mb = _to_microbatches(x, cfg.microbatches)
+            y = pipeline_apply_local(block_fn, p, x_mb, pp)
+            return jnp.sum(
+                (y.astype(jnp.float32)
+                 - _to_microbatches(target, cfg.microbatches)
+                 .astype(jnp.float32)) ** 2
+            )
+
+        loss, grads = jax.value_and_grad(local_loss)(params)
+        denom = float(np.prod(x.shape))
+        new_params = jax.tree.map(
+            lambda p, g: (p.astype(jnp.float32)
+                          - lr * g / denom).astype(p.dtype),
+            params, grads,
+        )
+        return new_params, loss / denom
+
+    sm = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(pp_param_specs(mesh), P(), P()),
+        out_specs=(pp_param_specs(mesh), P()),
+    )
+    return jax.jit(sm)
+
+
+def pipeline_reference(params: Params, x, cfg: PipelineConfig,
+                       block_fn: Callable = mlp_block):
+    """Single-device oracle: stages applied sequentially, no pipeline."""
+    y = x
+    for s in range(cfg.stages):
+        stage = {k: v[s:s + 1] for k, v in params.items()}
+        y = block_fn(stage, y)
+    return y
+
+
+def place_pipeline_params(params: Params, mesh: Mesh) -> Params:
+    specs = pp_param_specs(mesh)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}

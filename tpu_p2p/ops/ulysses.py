@@ -97,7 +97,7 @@ def ulysses_attention(mesh: Mesh, axis: str, causal: bool = False):
 
 def a2a_bytes_per_reshard(b: int, h: int, t: int, d: int, n: int, dtype) -> int:
     """Bytes each device exchanges per tensor reshard: all but the
-    ``1/n`` chunk it keeps of its ``B·(H/n)·(T/n)·D``-sized send."""
+    ``1/n`` chunk it keeps of its full ``B·H·(T/n)·D`` local block."""
     import numpy as np
 
     local = b * h * t * d * np.dtype(dtype).itemsize // n
