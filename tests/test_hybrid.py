@@ -1,0 +1,81 @@
+"""Hybrid ICI/DCN mesh logic — tested with fake multi-slice devices
+(no multi-slice hardware exists in CI; the grouping/validation logic
+is pure and the Mesh construction path is exercised on CPU errors)."""
+
+import numpy as np
+import pytest
+
+from tpu_p2p.parallel import topology as T
+from tpu_p2p.utils.errors import BackendError, PlacementError
+
+
+class FakeDev:
+    def __init__(self, id, slice_index=None, process_index=0):
+        self.id = id
+        self.slice_index = slice_index
+        self.process_index = process_index
+
+    def __repr__(self):
+        return f"FakeDev({self.id}, slice={self.slice_index})"
+
+
+def test_slices_from_devices_groups_and_orders():
+    devs = [FakeDev(i, slice_index=i // 4) for i in range(8)]
+    info = T.slices_from_devices(devs)
+    assert info.num_slices == 2 and info.devices_per_slice == 4
+    assert info.slice_of == (0, 0, 0, 0, 1, 1, 1, 1)
+
+
+def test_slices_none_without_slice_attr():
+    class Bare:
+        id = 0
+
+    assert T.slices_from_devices([Bare(), Bare()]) is None
+
+
+def test_uneven_slices_rejected():
+    devs = [FakeDev(0, 0), FakeDev(1, 0), FakeDev(2, 1)]
+    with pytest.raises(PlacementError, match="unevenly"):
+        T.slices_from_devices(devs)
+
+
+def test_hybrid_grid_rows_are_slices():
+    # Interleaved enumeration order must still land each slice in one row.
+    devs = [FakeDev(i, slice_index=i % 2) for i in range(8)]
+    grid = T.hybrid_device_grid(devs)
+    assert grid.shape == (2, 4)
+    for row in grid:
+        assert len({d.slice_index for d in row}) == 1
+        ids = [d.id for d in row]
+        assert ids == sorted(ids)
+
+
+def test_make_hybrid_runtime_rejects_cpu(rt):
+    # The simulated CPU devices expose no slice structure.
+    from tpu_p2p.parallel.runtime import make_hybrid_runtime
+
+    with pytest.raises(BackendError, match="multi-slice"):
+        make_hybrid_runtime()
+
+
+def test_cli_hybrid_flag_fails_cleanly_on_cpu(capsys):
+    from tpu_p2p.cli import main
+
+    rc = main(["--hybrid", "--pattern", "torus2d", "--iters", "1"])
+    assert rc == 1
+    assert "multi-slice" in capsys.readouterr().err
+
+
+def test_torus2d_on_a_faked_two_axis_mesh(capsys):
+    # End-to-end: a ('dcn', 'd') mesh shape over real CPU devices (the
+    # axes are just names) drives the same code path a hybrid runtime
+    # produces — per-axis rings over a 2-axis mesh.
+    from tpu_p2p.cli import main
+
+    rc = main([
+        "--pattern", "torus2d", "--mesh-shape", "2x4",
+        "--msg-size", "4KiB", "--iters", "2",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "axis 'x'" in out and "axis 'y'" in out

@@ -64,6 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="use only the first N devices")
     p.add_argument("--mesh-shape", default=None, metavar="AxB",
                    help="2D mesh, e.g. 4x2 (required for torus2d)")
+    p.add_argument("--hybrid", action="store_true",
+                   help="multi-slice jobs: build a ('dcn', 'd') mesh whose "
+                        "leading axis crosses DCN (use with --pattern "
+                        "torus2d to measure ICI vs DCN separately)")
     p.add_argument("--fused-repeats", type=int, default=3,
                    help="timed chain executions in fused mode")
     p.add_argument("--timeout", type=float, default=None, metavar="SECONDS",
@@ -154,11 +158,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         # Imports deferred past _force_cpu_mesh so the platform switch
         # precedes backend instantiation.
-        from tpu_p2p.parallel.runtime import make_runtime
+        from tpu_p2p.parallel.runtime import make_hybrid_runtime, make_runtime
         from tpu_p2p.utils.report import JsonlWriter, load_done_cells
         from tpu_p2p.workloads import WORKLOADS  # registers all patterns
 
-        rt = make_runtime(num_devices=cfg.num_devices, mesh_shape=cfg.mesh_shape)
+        if args.hybrid:
+            if cfg.mesh_shape is not None:
+                raise SystemExit(
+                    "--hybrid builds its own ('dcn', 'd') mesh; "
+                    "drop --mesh-shape"
+                )
+            if cfg.pattern != "torus2d":
+                raise SystemExit(
+                    "--hybrid currently supports --pattern torus2d (per-axis "
+                    f"rings separate DCN from ICI); {cfg.pattern!r} assumes "
+                    "a flat 1D mesh"
+                )
+            rt = make_hybrid_runtime(num_devices=cfg.num_devices)
+        else:
+            rt = make_runtime(
+                num_devices=cfg.num_devices, mesh_shape=cfg.mesh_shape
+            )
         if args.list_devices:
             _print_devices(rt)
             return 0

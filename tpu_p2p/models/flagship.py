@@ -232,15 +232,18 @@ def make_flagship_forward(mesh: Mesh, cfg: FlagshipConfig):
     return jax.jit(sm)
 
 
-def make_flagship_train_step(mesh: Mesh, cfg: FlagshipConfig,
-                             lr: float = 1e-2):
-    """One jitted SGD step: forward, backward, implicit gradient
-    reductions (shard_map autodiff — see
-    :mod:`tpu_p2p.models.ring_transformer` for the accounting), update."""
-    axes = _mesh_axes(mesh)
-    n_out = cfg.batch * cfg.seq * cfg.model_dim
+def make_flagship_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
+    """Jitted ``(params, x, target) → (grads, loss)`` over the mesh.
 
-    def step(params, x, target):
+    Loss is the global sum of squared error; gradient reductions are
+    implicit in ``shard_map`` autodiff (see
+    :mod:`tpu_p2p.models.ring_transformer` for the accounting). Grads
+    come back sharded exactly like the params, so any optimizer's
+    elementwise update runs shard-local under ``jit``.
+    """
+    axes = _mesh_axes(mesh)
+
+    def gstep(params, x, target):
         def local_loss(p):
             out = _forward_local(p, x, cfg, axes)
             return jnp.sum(
@@ -253,6 +256,26 @@ def make_flagship_train_step(mesh: Mesh, cfg: FlagshipConfig,
         data_axes = tuple(a for a in ("dp", "ep", "sp") if a in axes)
         if data_axes:
             loss = jax.lax.psum(loss, data_axes)
+        return grads, loss
+
+    sm = jax.shard_map(
+        gstep, mesh=mesh,
+        in_specs=(flagship_param_specs(mesh), flagship_data_spec(mesh),
+                  flagship_data_spec(mesh)),
+        out_specs=(flagship_param_specs(mesh), P()),
+    )
+    return jax.jit(sm)
+
+
+def make_flagship_train_step(mesh: Mesh, cfg: FlagshipConfig,
+                             lr: float = 1e-2):
+    """One jitted SGD step: forward, backward, update."""
+    grad_fn = make_flagship_grad_fn(mesh, cfg)
+    n_out = cfg.batch * cfg.seq * cfg.model_dim
+
+    @jax.jit
+    def step(params, x, target):
+        grads, loss = grad_fn(params, x, target)
         new_params = jax.tree.map(
             lambda p, g: (p.astype(jnp.float32)
                           - lr * g / n_out).astype(p.dtype),
@@ -260,13 +283,38 @@ def make_flagship_train_step(mesh: Mesh, cfg: FlagshipConfig,
         )
         return new_params, loss / n_out
 
-    sm = jax.shard_map(
-        step, mesh=mesh,
-        in_specs=(flagship_param_specs(mesh), flagship_data_spec(mesh),
-                  flagship_data_spec(mesh)),
-        out_specs=(flagship_param_specs(mesh), P()),
-    )
-    return jax.jit(sm)
+    return step
+
+
+def make_flagship_optax_step(mesh: Mesh, cfg: FlagshipConfig, tx):
+    """One jitted step under any optax ``GradientTransformation``.
+
+    ``(params, opt_state, x, target) → (params, opt_state, loss)``.
+    The optimizer math is plain elementwise jit outside the shard_map:
+    XLA propagates the param/grad shardings into the update, so mu/nu
+    moments shard exactly like their params. Initialize with
+    :func:`init_optimizer`.
+    """
+    import optax
+
+    grad_fn = make_flagship_grad_fn(mesh, cfg)
+    n_out = cfg.batch * cfg.seq * cfg.model_dim
+
+    @jax.jit
+    def step(params, opt_state, x, target):
+        grads, loss = grad_fn(params, x, target)
+        grads = jax.tree.map(lambda g: g / n_out, grads)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss / n_out
+
+    return step
+
+
+def init_optimizer(tx, params: Params):
+    """``tx.init`` under jit so the opt state inherits the params'
+    shardings (moments land shard-local, not replicated)."""
+    return jax.jit(tx.init)(params)
 
 
 def place_flagship_params(params: Params, mesh: Mesh) -> Params:

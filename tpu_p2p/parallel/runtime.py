@@ -108,6 +108,57 @@ class Runtime:
             jax.device_put(np.int32(0), d).block_until_ready()
 
 
+def make_hybrid_runtime(num_devices: Optional[int] = None,
+                        devices=None) -> Runtime:
+    """A 2-axis ``('dcn', 'd')`` mesh over a multi-slice TPU job.
+
+    Rows of the mesh are ICI islands (slices); the leading axis crosses
+    DCN — SURVEY.md §7 hard part (d). Collectives along ``'d'`` ride
+    ICI; along ``'dcn'`` they cross the data-center network, so the
+    ``torus2d`` workload over this mesh separates the two fabrics'
+    bandwidths. Prefers ``mesh_utils.create_hybrid_device_mesh`` (which
+    knows the physical ICI layout inside each slice) and falls back to
+    slice-index grouping.
+
+    Raises :class:`~tpu_p2p.utils.errors.BackendError` when the
+    platform has no multi-slice structure (CPU, single slice).
+    """
+    from tpu_p2p.utils.errors import BackendError
+
+    init_distributed()
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        check(
+            num_devices <= len(devices),
+            f"requested {num_devices} devices but only {len(devices)} visible",
+        )
+        devices = devices[:num_devices]
+    devices = tuple(devices)
+    info = topology.slices_from_devices(devices)
+    if info is None or info.num_slices < 2:
+        raise BackendError(
+            "hybrid mesh needs a multi-slice TPU job (devices exposing "
+            "slice_index over >= 2 slices); this platform shows "
+            + ("no slice structure" if info is None
+               else f"{info.num_slices} slice")
+        )
+    grid = None
+    try:
+        from jax.experimental import mesh_utils
+
+        grid = mesh_utils.create_hybrid_device_mesh(
+            (info.devices_per_slice,), (info.num_slices,), devices=devices
+        ).reshape(info.num_slices, info.devices_per_slice)
+    except Exception:
+        grid = topology.hybrid_device_grid(devices)
+    flat = list(grid.reshape(-1))
+    placement = topology.placement_from_devices(flat)
+    mesh = Mesh(grid, ("dcn", MESH_AXIS))
+    return Runtime(devices=tuple(flat), mesh=mesh, placement=placement,
+                   torus=topology.torus_from_devices(flat))
+
+
 def make_runtime(
     num_devices: Optional[int] = None,
     mesh_shape: Optional[Tuple[int, ...]] = None,

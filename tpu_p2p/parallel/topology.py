@@ -142,6 +142,60 @@ def placement_from_devices(devices) -> Placement:
 
 
 @dataclass(frozen=True)
+class SliceInfo:
+    """Multi-slice structure: which ICI island each device lives on.
+
+    TPU multi-slice jobs expose ``device.slice_index``; devices on the
+    same slice reach each other over ICI, across slices over DCN —
+    SURVEY.md §5's "mixed ICI/DCN meshes" (§7 hard part (d)).
+    """
+
+    num_slices: int
+    devices_per_slice: int
+    slice_of: tuple  # slice ordinal per device position
+
+
+def slices_from_devices(devices) -> Optional[SliceInfo]:
+    """Group devices by ``slice_index``; None when the platform does
+    not expose slices (CPU, single-slice libtpu builds)."""
+    ids = [getattr(d, "slice_index", None) for d in devices]
+    if not ids or any(i is None for i in ids):
+        return None
+    distinct = sorted(set(ids))
+    counts = {s: ids.count(s) for s in distinct}
+    if len(set(counts.values())) != 1:
+        raise PlacementError(
+            f"slices are unevenly sized: {counts} — a hybrid mesh needs "
+            "the same device count on every slice"
+        )
+    return SliceInfo(
+        num_slices=len(distinct),
+        devices_per_slice=counts[distinct[0]],
+        slice_of=tuple(distinct.index(i) for i in ids),
+    )
+
+
+def hybrid_device_grid(devices):
+    """Arrange devices as a ``[num_slices, devices_per_slice]`` grid —
+    rows are ICI islands, the column axis crosses DCN.
+
+    Raises :class:`PlacementError` when slices are uneven; returns
+    None when the platform exposes no slice structure.
+    """
+    import numpy as np
+
+    info = slices_from_devices(devices)
+    if info is None:
+        return None
+    rows = [[] for _ in range(info.num_slices)]
+    for d, s in zip(devices, info.slice_of):
+        rows[s].append(d)
+    for r in rows:
+        r.sort(key=lambda d: d.id)
+    return np.array(rows, dtype=object)
+
+
+@dataclass(frozen=True)
 class TorusInfo:
     """Physical torus shape + per-device coordinates, when exposed."""
 

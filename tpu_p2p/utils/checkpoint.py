@@ -1,0 +1,126 @@
+"""Checkpoint / resume for model state.
+
+SURVEY.md §5 "checkpoint / resume": the reference has none (its whole
+sweep just reruns, ``p2p_matrix.cc`` start to finish). The benchmark
+side of this framework already checkpoints per-cell via the JSONL
+twin of the stdout matrix (:mod:`tpu_p2p.utils.report`); this module
+adds the *model* side so training workloads (flagship / pipeline /
+ring transformer) can save and restore sharded params.
+
+Design: orbax-checkpoint when available (the idiomatic JAX answer —
+async-capable, multi-host aware), with a plain ``.npz`` fallback that
+has zero extra dependencies. Both paths round-trip arbitrary flat
+``dict[str, Array]`` pytrees and re-place them onto a target mesh via
+``NamedSharding``, so a checkpoint written under one mesh shape can be
+restored under another (the resharding is a ``device_put``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+Params = Dict[str, jax.Array]
+
+_META = "tpu_p2p_checkpoint.json"
+
+
+def save_params(path: str, params: Params, step: int = 0) -> str:
+    """Write ``params`` (+ step metadata) under directory ``path``.
+
+    Host-gathers each leaf (``np.asarray``) and writes one ``.npz`` —
+    simple, dependency-free, and correct for single-process use; the
+    orbax path (:func:`save_params_orbax`) covers multi-host.
+    """
+    os.makedirs(path, exist_ok=True)
+    arrays = {k: np.asarray(v) for k, v in params.items()}
+    np.savez(os.path.join(path, "params.npz"), **arrays)
+    with open(os.path.join(path, _META), "w") as fh:
+        json.dump(
+            {"step": step, "keys": sorted(arrays),
+             "dtypes": {k: str(v.dtype) for k, v in arrays.items()}},
+            fh,
+        )
+    return path
+
+
+def load_params(path: str, mesh: Optional[Mesh] = None,
+                specs: Optional[dict] = None):
+    """Restore ``(params, step)``; re-place onto ``mesh`` if given.
+
+    ``specs``: ``{name: PartitionSpec}`` as produced by the model's
+    ``*_param_specs(mesh)`` — restoring under a different mesh shape
+    than the save is fine; placement is just a ``device_put``.
+    """
+    import ml_dtypes  # noqa: F401 — registers bfloat16 etc. with numpy
+
+    with open(os.path.join(path, _META)) as fh:
+        meta = json.load(fh)
+    with np.load(os.path.join(path, "params.npz")) as z:
+        arrays = {k: z[k] for k in z.files}
+    # npz stores extension dtypes (bfloat16, fp8) as raw void bytes;
+    # re-view them through the dtype recorded at save time.
+    for k, want in meta.get("dtypes", {}).items():
+        if k in arrays and str(arrays[k].dtype) != want:
+            arrays[k] = arrays[k].view(np.dtype(want))
+    if set(arrays) != set(meta["keys"]):
+        raise ValueError(
+            f"checkpoint at {path} is torn: meta lists {meta['keys']}, "
+            f"npz holds {sorted(arrays)}"
+        )
+    if mesh is not None and specs is not None:
+        params = {
+            k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in arrays.items()
+        }
+    else:
+        params = {k: jax.numpy.asarray(v) for k, v in arrays.items()}
+    return params, meta.get("step", 0)
+
+
+def save_params_orbax(path: str, params: Params, step: int = 0) -> str:
+    """Orbax save — multi-host safe, async-capable. Falls back to
+    :func:`save_params` when orbax is unavailable."""
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError:
+        return save_params(path, params, step)
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.join(path, f"step_{step}"), params)
+    with open(os.path.join(path, _META), "w") as fh:
+        json.dump({"step": step, "format": "orbax"}, fh)
+    return path
+
+
+def load_params_orbax(path: str, template: Params, step: int = 0) -> Params:
+    """Orbax restore against a sharded ``template`` (abstract or
+    concrete arrays carrying the target shardings).
+
+    Mirrors :func:`save_params_orbax`'s fallback: a checkpoint written
+    on an orbax-less host is an npz (meta lacks ``format: orbax``) and
+    is loaded through :func:`load_params`, re-placed onto the
+    template's shardings.
+    """
+    path = os.path.abspath(path)
+    with open(os.path.join(path, _META)) as fh:
+        meta = json.load(fh)
+    if meta.get("format") != "orbax":
+        params, _ = load_params(path)
+        return {
+            k: jax.device_put(v, template[k].sharding)
+            if hasattr(template[k], "sharding") else v
+            for k, v in params.items()
+        }
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(
+            os.path.join(path, f"step_{step}"),
+            jax.tree.map(ocp.utils.to_shape_dtype_struct, template),
+        )
