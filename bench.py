@@ -29,6 +29,41 @@ import sys
 NVLINK_A100_GBPS = 1600.0  # ~200 GB/s busbw class, BASELINE.md anchor
 
 
+def _flash_tflops(timing) -> float:
+    """Causal flash-attention TFLOP/s at T=16k/D=128 bf16, measured by
+    the same differential-chain method as the bandwidth numbers (the
+    compute half of the framework's single-chip story — BASELINE.md
+    "Measured" table)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_p2p.ops.flash_attention import flash_attention
+
+    b, h, t, d = 1, 4, 16384, 128
+    rng = np.random.default_rng(0)
+    kv = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+
+    def make_chain(n):
+        @jax.jit
+        def f(q):
+            def step(c, _):
+                return flash_attention(c, kv, kv, True), None
+            out, _ = jax.lax.scan(step, q, None, length=n)
+            return out
+
+        return f
+
+    # Longer chain + more repeats than the bandwidth configs: each call
+    # is only ~3 ms, so relay jitter needs more averaging to clear.
+    s = timing.measure_differential(make_chain, q, 16, repeats=5)
+    flops = 2 * b * h * t * t * d  # causal: half of the 4*b*h*t^2*d dense
+    if s.mean_region != s.mean_region or s.mean_region <= 0:
+        return float("nan")
+    return round(flops / s.mean_region / 1e12, 1)
+
+
 def main() -> int:
     import numpy as np
 
@@ -92,6 +127,7 @@ def main() -> int:
         s8 = timing.measure_differential(
             lambda k: cache.loopback_chain(rt.mesh, k), x8, 4096, repeats=4
         )
+        flash_tflops = _flash_tflops(timing)
         result = {
             "metric": "loopback_hbm_rewrite_bandwidth",
             "value": round(float(value), 3),
@@ -107,6 +143,7 @@ def main() -> int:
                     else None
                 ),
                 "per_op_floor_us": round(s8.mean_region * 1e6, 2),
+                "flash_attention_tflops": flash_tflops,
                 "mode": "differential",
                 "block_fence_trustworthy": fence_ok,
             },

@@ -137,3 +137,14 @@ def test_orbax_loader_reads_npz_fallback(tmp_path):
         np.testing.assert_array_equal(np.asarray(restored[k]),
                                       np.asarray(params[k]))
         assert restored[k].sharding == placed[k].sharding
+
+
+def test_orbax_loader_npz_fallback_rejects_wrong_step(tmp_path):
+    cfg = _cfg()
+    params = F.init_flagship_params(cfg)
+    path = C.save_params(str(tmp_path / "sck"), params, step=1)
+    try:
+        C.load_params_orbax(path, params, step=3)
+        raise AssertionError("expected step-mismatch error")
+    except ValueError as e:
+        assert "step" in str(e)

@@ -107,3 +107,36 @@ def test_flagship_bad_divisibility_raises():
         params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
         x, _ = F.flagship_example_batch(cfg)
         F.make_flagship_forward(mesh, cfg)(params, x)
+
+
+@pytest.mark.parametrize("shape", [(2, 1, 2, 1, 2), (1, 2, 2, 2, 1)])
+def test_flagship_ulysses_strategy_matches_single_device(shape):
+    import dataclasses
+
+    cfg = dataclasses.replace(_cfg(), sp_strategy="ulysses")
+    params = F.init_flagship_params(cfg)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(
+        rng.standard_normal((cfg.batch, cfg.seq, cfg.model_dim)),
+        dtype=jnp.float32,
+    )
+    want = _oracle(cfg, params, x)
+    mesh = _mesh(shape)
+    placed = F.place_flagship_params(params, mesh)
+    got = np.asarray(F.make_flagship_forward(mesh, cfg)(placed, x))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_flagship_ulysses_train_step_decreases_loss():
+    import dataclasses
+
+    cfg = dataclasses.replace(_cfg(), sp_strategy="ulysses")
+    mesh = _mesh((1, 1, 2, 2, 2))
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    x, t = F.flagship_example_batch(cfg, mesh)
+    step = F.make_flagship_train_step(mesh, cfg, lr=5e-2)
+    losses = []
+    for _ in range(3):
+        params, loss = step(params, x, t)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses

@@ -83,3 +83,48 @@ def test_bad_expert_shard_count_raises():
     mesh = _ep_mesh()
     with pytest.raises(Exception, match="expert shards|divisible|not divisible"):
         M.make_moe_layer(mesh, cfg)(params, x)
+
+
+def test_top2_matches_dense_oracle():
+    cfg, params, x = _setup()
+    import dataclasses
+
+    cfg2 = dataclasses.replace(cfg, router_top_k=2)
+    got = np.asarray(M.moe_layer_local(params, x, cfg2))
+    want = np.asarray(M.moe_reference(params, x, cfg2))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_top2_ep_sharded_matches_unsharded():
+    import dataclasses
+
+    cfg, params, x = _setup()
+    cfg = dataclasses.replace(cfg, router_top_k=2)
+    mesh = _ep_mesh()
+    layer = M.make_moe_layer(mesh, cfg)
+    placed = {
+        k: jax.device_put(v, NamedSharding(mesh, s))
+        for (k, v), s in zip(params.items(),
+                             M.ep_param_specs(mesh).values())
+    }
+    got = np.asarray(layer(placed, x))
+    want = np.asarray(M.moe_reference(params, x, cfg))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_top2_gates_renormalized():
+    # With ample capacity, the two gates of each token sum to 1.
+    cfg, params, x = _setup(g=16)
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, router_top_k=2)
+    cap = cfg.capacity(16)
+    dispatch, combine = M._route_topk(
+        x, params["router"], cfg.num_experts, cap, k=2
+    )
+    gate_sum = np.asarray(combine.sum(axis=(1, 2)))
+    np.testing.assert_allclose(gate_sum, np.ones(16), atol=1e-6)
+    # and each token occupies exactly 2 slots
+    np.testing.assert_allclose(
+        np.asarray(dispatch.sum(axis=(1, 2))), np.full(16, 2.0), atol=1e-6
+    )

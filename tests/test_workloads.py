@@ -196,3 +196,13 @@ def test_ulysses_workload_odd_device_count_defaults_divisible(capsys):
     ])
     assert rc == 0
     assert "H9" in capsys.readouterr().out  # 3 * ceil(8/3) = 9 heads
+
+
+def test_flagship_step_workload_end_to_end(capsys):
+    from tpu_p2p.cli import main
+
+    rc = main(["--pattern", "flagship_step", "--iters", "2",
+               "--dtype", "float32"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "flagship_step mesh" in out and "tokens/s" in out

@@ -70,6 +70,7 @@ PATTERNS = (
     "latency",       # 8B p50 send/recv latency (BASELINE metric)
     "ring_attention",  # flagship SP workload over the same transport
     "ulysses_attention",  # all_to_all SP counterpart (configs[3] transport)
+    "flagship_step",  # the composite 5-axis train-step benchmark
 )
 
 MODES = ("serialized", "fused", "differential")  # SURVEY.md §7 hard part (c);

@@ -66,6 +66,9 @@ class FlagshipConfig:
     moe_mult: int = 2        # expert FFN width = moe_mult * model_dim
     causal: bool = True
     dtype: str = "float32"
+    sp_strategy: str = "ring"  # "ring" (ppermute KV rotation) or
+    # "ulysses" (head<->seq all_to_all) — the two SP families of
+    # SURVEY.md §2.3; ulysses needs heads % sp == 0
 
     @property
     def model_dim(self) -> int:
@@ -87,7 +90,8 @@ class FlagshipConfig:
             self,
             batch=2 * dpep * self.microbatches,
             seq=16 * sp,
-            heads=2 * tp,
+            heads=2 * tp * sp,  # divisible by tp AND sp, so either SP
+            # strategy (ring or ulysses) shards cleanly
             head_dim=8,
             stages=pp,
             num_experts=2 * ax.get("ep", 1),
@@ -151,7 +155,11 @@ def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
     q = jnp.einsum("btm,hmd->bhtd", x, sub_params["wq"])
     k = jnp.einsum("btm,hmd->bhtd", x, sub_params["wk"])
     v = jnp.einsum("btm,hmd->bhtd", x, sub_params["wv"])
-    if sp is not None:
+    if sp is not None and cfg.sp_strategy == "ulysses":
+        from tpu_p2p.ops.ulysses import ulysses_attention_local
+
+        a = ulysses_attention_local(q, k, v, sp, causal=cfg.causal)
+    elif sp is not None:
         a = ring_attention_local(q, k, v, sp, causal=cfg.causal)
     else:
         a = dense_attention(q, k, v, causal=cfg.causal)

@@ -46,10 +46,16 @@ class MoEConfig:
     d_ff: int = 128
     num_experts: int = 8
     capacity_factor: float = 2.0
+    router_top_k: int = 1  # 1 = Switch routing; 2 = GShard-style top-2
+    # with renormalized gates
 
     def capacity(self, tokens: int) -> int:
-        """Per-expert slot count for ``tokens`` routed tokens."""
-        return max(1, math.ceil(tokens * self.capacity_factor / self.num_experts))
+        """Per-expert slot count for ``tokens`` routed tokens (each
+        token takes ``router_top_k`` slots total)."""
+        return max(1, math.ceil(
+            tokens * self.router_top_k * self.capacity_factor
+            / self.num_experts
+        ))
 
 
 def init_moe_params(cfg: MoEConfig, seed: int = 0, dtype=jnp.float32) -> Params:
@@ -67,25 +73,42 @@ def init_moe_params(cfg: MoEConfig, seed: int = 0, dtype=jnp.float32) -> Params:
     }
 
 
-def _route_top1(x, router_w, num_experts: int, capacity: int):
-    """Switch-style top-1 routing with static capacity.
+def _route_topk(x, router_w, num_experts: int, capacity: int, k: int = 1):
+    """Top-``k`` routing with static capacity (Switch at k=1, GShard-
+    style at k=2).
 
     Returns ``(dispatch [G,E,C] bool-ish, combine [G,E,C] f32)`` for
-    ``G`` local tokens: dispatch places each kept token in its expert's
-    next free slot; combine carries the router's softmax gate weight.
+    ``G`` local tokens. Each token's ``k`` expert choices are placed in
+    their experts' next free slots — choice ranks allocate in order, so
+    first choices win slots over second choices, matching GShard's
+    priority. Gates are the chosen experts' softmax probabilities
+    renormalized over the k choices (dropped choices lose their mass).
     """
     logits = jnp.einsum("gd,de->ge", x.astype(jnp.float32),
                         router_w.astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                      # [G]
-    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)  # [G,E]
-    # Slot index of each token within its expert (first-come order).
-    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot       # [G,E]
-    keep = (pos < capacity) * onehot                         # drops overflow
-    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
-    dispatch = keep[..., None] * slot                        # [G,E,C]
-    gate = jnp.sum(probs * keep, axis=-1, keepdims=True)     # [G,1]
-    combine = dispatch * gate[..., None]
+    top_p, top_e = jax.lax.top_k(probs, k)                   # [G,k]
+    if k == 1:
+        gates = top_p  # Switch semantics: the raw softmax probability
+    else:
+        denom = jnp.sum(top_p, axis=-1, keepdims=True)
+        gates = top_p / jnp.maximum(denom, 1e-9)             # renormalized
+
+    dispatch = jnp.zeros((x.shape[0], num_experts, capacity), jnp.float32)
+    combine = jnp.zeros_like(dispatch)
+    used = jnp.zeros((num_experts,), jnp.float32)            # slots taken
+    for r in range(k):  # k is tiny and static — unrolled
+        onehot = jax.nn.one_hot(top_e[:, r], num_experts, dtype=jnp.float32)
+        # Slot index within the expert: first-come order among this
+        # rank's tokens, offset by slots earlier ranks consumed.
+        pos = (jnp.cumsum(onehot, axis=0) - onehot + used[None, :]) * onehot
+        keep = (pos < capacity) * onehot
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                              dtype=jnp.float32)
+        d_r = keep[..., None] * slot                         # [G,E,C]
+        dispatch = dispatch + d_r
+        combine = combine + d_r * gates[:, r, None, None]
+        used = used + jnp.sum(onehot, axis=0)
     return dispatch, combine
 
 
@@ -108,7 +131,8 @@ def moe_layer_local(params: Params, x, cfg: MoEConfig, ep_axis=None):
             f"expert shards ({e_local}) × ep size ({n}) != experts ({e})"
         )
 
-    dispatch, combine = _route_top1(x, params["router"], e, cap)
+    dispatch, combine = _route_topk(x, params["router"], e, cap,
+                                    k=cfg.router_top_k)
     # Gather routed tokens into per-expert slots: [E, C, D].
     slots = jnp.einsum("gec,gd->ecd", dispatch.astype(x.dtype), x,
                        preferred_element_type=jnp.float32).astype(x.dtype)
@@ -130,23 +154,32 @@ def moe_layer_local(params: Params, x, cfg: MoEConfig, ep_axis=None):
 
 
 def moe_reference(params: Params, x, cfg: MoEConfig):
-    """Capacity-free oracle: every token through its top-1 expert.
+    """Capacity-free oracle: every token through its top-k experts.
 
-    Computes all experts densely for every token and selects — O(G·E)
+    Computes all experts densely for every token and gathers — O(G·E)
     compute, fine at test scale. Matches ``moe_layer_local`` exactly
     whenever capacity is large enough that nothing drops.
     """
+    k = cfg.router_top_k
     logits = jnp.einsum("gd,de->ge", x.astype(jnp.float32),
                         params["router"].astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)
-    gate = jnp.max(probs, axis=-1)
+    top_p, top_e = jax.lax.top_k(probs, k)
+    if k == 1:
+        gates = top_p
+    else:
+        gates = top_p / jnp.maximum(
+            jnp.sum(top_p, axis=-1, keepdims=True), 1e-9
+        )
     h = jax.nn.gelu(jnp.einsum("gd,edf->egf", x, params["w1"],
                                preferred_element_type=jnp.float32))
     y = jnp.einsum("egf,efd->egd", h.astype(x.dtype), params["w2"],
-                   preferred_element_type=jnp.float32)
-    sel = jnp.take_along_axis(y, expert[None, :, None], axis=0)[0]
-    return (sel * gate[:, None]).astype(x.dtype)
+                   preferred_element_type=jnp.float32)  # [E,G,D]
+    out = jnp.zeros((x.shape[0], x.shape[1]), jnp.float32)
+    for r in range(k):
+        sel = jnp.take_along_axis(y, top_e[None, :, r, None], axis=0)[0]
+        out = out + sel * gates[:, r, None]
+    return out.astype(x.dtype)
 
 
 def ep_param_specs(mesh):

@@ -111,7 +111,12 @@ def load_params_orbax(path: str, template: Params, step: int = 0) -> Params:
     with open(os.path.join(path, _META)) as fh:
         meta = json.load(fh)
     if meta.get("format") != "orbax":
-        params, _ = load_params(path)
+        params, have_step = load_params(path)
+        if have_step != step:
+            raise ValueError(
+                f"checkpoint at {path} holds step {have_step}, "
+                f"not the requested step {step}"
+            )
         return {
             k: jax.device_put(v, template[k].sharding)
             if hasattr(template[k], "sharding") else v

@@ -1,0 +1,74 @@
+"""flagship_step workload — the composite train-step benchmark.
+
+The transport patterns (pairwise/ring/all_to_all/torus) measure one
+collective at a time; this workload times the framework's full 5-axis
+training step (:mod:`tpu_p2p.models.flagship`: GPipe ppermute over pp,
+ring-or-ulysses SP, tp psum, MoE all_to_all over ep, dp batch) as one
+compiled program — the composite number a training stack sees, which
+no single-collective matrix predicts (SURVEY.md §5 "long-context /
+sequence parallelism").
+
+The benchmark runtime's devices are refactored over the 5-axis mesh by
+:func:`~tpu_p2p.models.flagship.build_mesh`; model shapes come from
+``FlagshipConfig().tiny(mesh)`` (``--dtype float32|bfloat16`` applies;
+pass a ``model_cfg`` programmatically for other shapes).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tpu_p2p.utils import timing
+from tpu_p2p.workloads.base import WorkloadContext, cell_record, workload
+
+
+@workload("flagship_step")
+def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
+    import dataclasses
+
+    from tpu_p2p.models import flagship as F
+
+    rt, cfg = ctx.rt, ctx.cfg
+    mesh = F.build_mesh(rt.num_devices, devices=list(rt.devices))
+    mc = model_cfg or F.FlagshipConfig().tiny(mesh)
+    if mc.sp_strategy not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sp_strategy {mc.sp_strategy!r}")
+    if model_cfg is None and cfg.dtype in ("bfloat16", "float32"):
+        mc = dataclasses.replace(mc, dtype=cfg.dtype)
+    params = F.place_flagship_params(F.init_flagship_params(mc), mesh)
+    x, t = F.flagship_example_batch(mc, mesh)
+    step = F.make_flagship_train_step(mesh, mc)
+
+    state = {"params": params}
+
+    def one_step(args):
+        x, t = args
+        new_params, loss = step(state["params"], x, t)
+        state["params"] = new_params  # thread params so steps are real
+        return loss
+
+    s = timing.measure_serialized(
+        one_step, (x, t), cfg.iters,
+        warmup=max(1, cfg.warmup), timeout_s=cfg.timeout_s,
+        barrier=rt.barrier,
+    )
+    tokens = mc.batch * mc.seq
+    tok_s = tokens / s.p50 if s.p50 == s.p50 and s.p50 > 0 else float("nan")
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if ctx.is_printer:
+        sys.stdout.write(
+            f"flagship_step mesh {axes} {mc.sp_strategy}-SP "
+            f"B{mc.batch} T{mc.seq} H{mc.heads} E{mc.num_experts} "
+            f"S{mc.stages}x{mc.microbatches}mb {mc.dtype}: "
+            f"p50 {s.p50 * 1e3:.2f}ms/step  {tok_s:,.0f} tokens/s\n"
+        )
+        sys.stdout.flush()
+    ctx.record(
+        cell_record(
+            ctx, workload="flagship_step", direction="uni", src=0, dst=0,
+            msg_bytes=0, gbps_val=float("nan"), samples=s,
+            mesh=str(axes), sp_strategy=mc.sp_strategy,
+            batch=mc.batch, seq=mc.seq, tokens_per_s=tok_s,
+        )
+    )
+    return {"mesh": axes, "p50_ms": s.p50 * 1e3, "tokens_per_s": tok_s}
