@@ -127,7 +127,13 @@ def main() -> int:
         s8 = timing.measure_differential(
             lambda k: cache.loopback_chain(rt.mesh, k), x8, 4096, repeats=4
         )
-        flash_tflops = _flash_tflops(timing)
+        try:
+            flash_tflops = _flash_tflops(timing)
+        except Exception as e:  # noqa: BLE001 — keep the bandwidth
+            # numbers already measured above even if the compute
+            # benchmark fails (OOM, compile error, odd backend).
+            print(f"# flash tflops measurement failed: {e!r}", file=sys.stderr)
+            flash_tflops = float("nan")
         result = {
             "metric": "loopback_hbm_rewrite_bandwidth",
             "value": round(float(value), 3),

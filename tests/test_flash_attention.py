@@ -73,8 +73,65 @@ def test_carry_block_chain_matches_dense(causal):
                                atol=1e-5, rtol=1e-5)
 
 
-def test_flash_grad_matches_dense_grad():
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grad_matches_dense_grad(causal):
     q, k, v = _qkv(t=32, d=16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(F.flash_attention(q, k, v, causal) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(A.dense_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_flash_grad_multi_tile_causal():
+    """t=2048 against the backward's 1024-tile: 2x2 tiles per kernel,
+    so the dk/dv seed-once-accumulate-across-q-sweep logic, the dq KV
+    sweep, and the causal tile-skip branch all run with >1 tile each
+    way (keep t > the `_bwd_blocks` preferred tile or this degrades to
+    a single-tile grid that covers none of those paths)."""
+    q, k, v = _qkv(b=1, h=1, t=2048, d=8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(F.flash_attention(q, k, v, True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(A.dense_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_flash_grad_bf16():
+    q, k, v = _qkv(t=64, d=16, dtype=jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(F.flash_attention(q, k, v, True).astype(jnp.float32))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(A.dense_attention(q, k, v, causal=True).astype(jnp.float32))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=5e-2, rtol=5e-2,
+        )
+
+
+def test_flash_grad_non_divisible_seq():
+    q, k, v = _qkv(t=48, d=16)
 
     def loss_flash(q, k, v):
         return jnp.sum(F.flash_attention(q, k, v, True) ** 2)
