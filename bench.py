@@ -29,7 +29,7 @@ import sys
 NVLINK_A100_GBPS = 1600.0  # ~200 GB/s busbw class, BASELINE.md anchor
 
 
-def _flash_tflops(timing) -> float:
+def _flash_tflops(timing):
     """Causal flash-attention TFLOP/s at T=16k/D=128 bf16, measured by
     the same differential-chain method as the bandwidth numbers (the
     compute half of the framework's single-chip story — BASELINE.md
@@ -60,7 +60,7 @@ def _flash_tflops(timing) -> float:
     s = timing.measure_differential(make_chain, q, 16, repeats=5)
     flops = 2 * b * h * t * t * d  # causal: half of the 4*b*h*t^2*d dense
     if s.mean_region != s.mean_region or s.mean_region <= 0:
-        return float("nan")
+        return None  # None, not NaN: json.dumps(NaN) is invalid JSON
     return round(flops / s.mean_region / 1e12, 1)
 
 
@@ -133,7 +133,7 @@ def main() -> int:
             # numbers already measured above even if the compute
             # benchmark fails (OOM, compile error, odd backend).
             print(f"# flash tflops measurement failed: {e!r}", file=sys.stderr)
-            flash_tflops = float("nan")
+            flash_tflops = None
         result = {
             "metric": "loopback_hbm_rewrite_bandwidth",
             "value": round(float(value), 3),

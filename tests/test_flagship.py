@@ -140,3 +140,55 @@ def test_flagship_ulysses_train_step_decreases_loss():
         params, loss = step(params, x, t)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.parametrize("shape", [(2, 2, 2, 1, 1), (1, 1, 2, 2, 2)])
+def test_flagship_gqa_forward_matches_single_device(shape):
+    """GQA flagship (kv_heads < heads): every mesh factorization —
+    including tp over both head tensors and ring SP over the narrow
+    KV — must still match the single-device oracle."""
+    cfg = F.FlagshipConfig(
+        batch=8, seq=32, heads=4, kv_heads=2, head_dim=8, stages=2,
+        microbatches=2, num_experts=4, capacity_factor=4.0,
+        dtype="float32",
+    )
+    params = F.init_flagship_params(cfg)
+    assert params["wk"].shape[1] == 2
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(
+        rng.standard_normal((cfg.batch, cfg.seq, cfg.model_dim)),
+        dtype=jnp.float32,
+    )
+    want = _oracle(cfg, params, x)
+    mesh = _mesh(shape)
+    placed = F.place_flagship_params(params, mesh)
+    got = np.asarray(F.make_flagship_forward(mesh, cfg)(placed, x))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_flagship_gqa_train_step_decreases_loss():
+    cfg = F.FlagshipConfig(
+        batch=8, seq=32, heads=4, kv_heads=1, head_dim=8, stages=2,
+        microbatches=2, num_experts=4, capacity_factor=4.0,
+        dtype="float32",
+    )
+    mesh = _mesh((2, 1, 2, 1, 2))
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    x, t = F.flagship_example_batch(cfg, mesh)
+    step = F.make_flagship_train_step(mesh, cfg, lr=5e-2)
+    losses = []
+    for _ in range(4):
+        params, loss = step(params, x, t)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_tiny_preserves_or_resets_gqa():
+    mesh1 = _mesh((1, 1, 1, 1, 1))
+    # Ratio 2 fits the shrunken head count (heads=2 → kv=1).
+    c = F.FlagshipConfig(heads=8, kv_heads=4).tiny(mesh1)
+    assert c.heads % c.num_kv_heads == 0
+    assert c.heads // c.num_kv_heads == 2
+    # Ratio 8 can't fit heads=2 → falls back to MHA, never kv > heads.
+    c = F.FlagshipConfig(heads=8, kv_heads=1).tiny(mesh1)
+    assert c.num_kv_heads == c.heads

@@ -158,3 +158,83 @@ def test_ring_attention_use_flash_matches_oracle(rt):
     want = A.dense_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("h_kv", [1, 2])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_matches_dense(causal, h_kv):
+    """GQA (grouped) and MQA (h_kv=1): narrow KV read via the kernel's
+    row map must equal the dense oracle over repeated heads."""
+    b, h, t, d = 2, 4, 64, 16
+    q = _qkv(b=b, h=h, t=t, d=d)[0]
+    k, v = _qkv(b=b, h=h_kv, t=t, d=d, seed=3)[1:]
+    want = A.dense_attention(q, k, v, causal=causal)
+    got = F.flash_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("h_kv", [1, 2])
+def test_flash_gqa_grad_matches_dense_grad(h_kv):
+    """dk/dv must come back in the narrow KV shape, group-summed."""
+    b, h, t, d = 2, 4, 32, 16
+    q = _qkv(b=b, h=h, t=t, d=d)[0]
+    k, v = _qkv(b=b, h=h_kv, t=t, d=d, seed=5)[1:]
+
+    def loss_flash(q, k, v):
+        return jnp.sum(F.flash_attention(q, k, v, True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(A.dense_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    assert gf[1].shape == (b, h_kv, t, d)
+    assert gf[2].shape == (b, h_kv, t, d)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_flash_gqa_grad_multi_tile():
+    """GQA backward with >1 tile per grid dim: the per-q-head dk/dv
+    accumulation must survive tile sweeps before the group sum."""
+    q = _qkv(b=1, h=4, t=2048, d=8)[0]
+    k, v = _qkv(b=1, h=2, t=2048, d=8, seed=9)[1:]
+
+    def loss_flash(q, k, v):
+        return jnp.sum(F.flash_attention(q, k, v, True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(A.dense_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_ring_attention_gqa_use_flash_matches_oracle(rt):
+    """GQA ring attention on the flash path: the rotating KV blocks
+    stay narrow (H_kv heads) while queries keep H."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(rt.devices[:4]), ("sp",))
+    b, h, h_kv, t, d = 2, 4, 2, 64, 16
+    q = _qkv(b=b, h=h, t=t, d=d)[0]
+    k, v = _qkv(b=b, h=h_kv, t=t, d=d, seed=11)[1:]
+    fn = A.ring_attention(mesh, "sp", causal=True, use_flash=True)
+    got = fn(q, k, v)
+    want = A.dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_flash_gqa_rejects_non_divisible_heads():
+    """Non-divisible head counts must raise, not clamp index maps into
+    silently wrong output (floor-division hazard in the group derive)."""
+    q = _qkv(b=2, h=4, t=32, d=16)[0]
+    k, v = _qkv(b=2, h=3, t=32, d=16, seed=2)[1:]
+    with pytest.raises(ValueError, match="multiple of KV heads"):
+        F.flash_attention(q, k, v, True)

@@ -74,3 +74,20 @@ def test_a2a_bytes_helper():
     assert U.a2a_bytes_per_reshard(2, 8, 64, 16, 8, jnp.bfloat16) == (
         2 * 8 * 64 * 16 * 2 // 8 * 7 // 8
     )
+
+
+def test_ulysses_gqa_matches_dense(rt):
+    """GQA through Ulysses: both head counts reshard over the axis."""
+    q = _qkv(h=16)[0]
+    k, v = _qkv(h=8, seed=3)[1:]
+    fn = U.ulysses_attention(rt.mesh, "d", True)
+    got = np.asarray(fn(q, k, v))
+    want = np.asarray(A.dense_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_kv_heads(rt):
+    q = _qkv(h=8)[0]
+    k, v = _qkv(h=4, seed=3)[1:]  # 4 KV heads on an 8-way axis
+    with pytest.raises(ValueError, match="KV heads"):
+        U.ulysses_attention(rt.mesh, "d", False)(q, k, v)

@@ -58,6 +58,10 @@ class FlagshipConfig:
     batch: int = 8
     seq: int = 256
     heads: int = 8
+    kv_heads: int = 0    # 0 → same as heads (MHA); otherwise GQA/MQA:
+    # heads % kv_heads == 0, and under tp both counts must divide by
+    # the tp axis. The ring SP path then ships kv_heads/heads of the
+    # MHA bytes per ppermute hop.
     head_dim: int = 32
     stages: int = 2          # total pipeline stages (multiple of pp size)
     microbatches: int = 2
@@ -74,6 +78,10 @@ class FlagshipConfig:
     def model_dim(self) -> int:
         return self.heads * self.head_dim
 
+    @property
+    def num_kv_heads(self) -> int:
+        return self.kv_heads or self.heads
+
     def moe(self) -> MoEConfig:
         return MoEConfig(
             d_model=self.model_dim, d_ff=self.moe_mult * self.model_dim,
@@ -86,12 +94,22 @@ class FlagshipConfig:
         ax = dict(zip(mesh.axis_names, mesh.devices.shape))
         tp, sp, pp = ax.get("tp", 1), ax.get("sp", 1), ax.get("pp", 1)
         dpep = ax.get("dp", 1) * ax.get("ep", 1)
+        heads = 2 * tp * sp
+        # Preserve the GQA ratio when it still yields a valid KV head
+        # count at the shrunken query head count (divisible, tp-
+        # shardable); otherwise fall back to MHA rather than produce
+        # kv_heads > heads or a non-dividing group.
+        ratio = self.heads // self.num_kv_heads
+        kv = heads // ratio if heads % ratio == 0 else 0
+        if kv and (heads % kv or kv % tp):
+            kv = 0
         return replace(
             self,
             batch=2 * dpep * self.microbatches,
             seq=16 * sp,
-            heads=2 * tp * sp,  # divisible by tp AND sp, so either SP
+            heads=heads,  # divisible by tp AND sp, so either SP
             # strategy (ring or ulysses) shards cleanly
+            kv_heads=kv,
             head_dim=8,
             stages=pp,
             num_experts=2 * ax.get("ep", 1),
@@ -105,7 +123,7 @@ def _axis(mesh: Mesh, name: str):
 
 def init_flagship_params(cfg: FlagshipConfig, seed: int = 0) -> Params:
     rng = np.random.default_rng(seed)
-    s, h = cfg.stages, cfg.heads
+    s, h, hkv = cfg.stages, cfg.heads, cfg.num_kv_heads
     dm, dh = cfg.model_dim, cfg.head_dim
     e, f = cfg.num_experts, cfg.moe_mult * cfg.model_dim
     dtype = jnp.dtype(cfg.dtype)
@@ -116,8 +134,8 @@ def init_flagship_params(cfg: FlagshipConfig, seed: int = 0) -> Params:
 
     return {
         "wq": w(s, h, dm, dh, fan_in=dm),
-        "wk": w(s, h, dm, dh, fan_in=dm),
-        "wv": w(s, h, dm, dh, fan_in=dm),
+        "wk": w(s, hkv, dm, dh, fan_in=dm),
+        "wv": w(s, hkv, dm, dh, fan_in=dm),
         "wo": w(s, h, dh, dm, fan_in=dh),
         "router": w(s, dm, e, fan_in=dm),
         "we1": w(s, e, dm, f, fan_in=dm),

@@ -38,8 +38,29 @@ NEG_INF = -1e30  # large-negative instead of -inf: keeps XLA happy on
 # fully-masked rows (no NaN from (-inf) - (-inf))
 
 
+def repeat_kv(x, h_q: int):
+    """``[B, H_kv, T, D] → [B, H_q, T, D]``: repeat each KV head over
+    its query group (GQA; ``h_kv == 1`` is MQA). Identity when the head
+    counts already match, so every attention path accepts GQA inputs
+    transparently."""
+    h_kv = x.shape[1]
+    if h_kv == h_q:
+        return x
+    if h_q % h_kv:
+        raise ValueError(
+            f"query heads ({h_q}) must be a multiple of KV heads ({h_kv})"
+        )
+    return jnp.repeat(x, h_q // h_kv, axis=1)
+
+
 def dense_attention(q, k, v, *, causal: bool = False):
-    """Reference single-device attention (test oracle)."""
+    """Reference single-device attention (test oracle).
+
+    Accepts GQA/MQA inputs: ``k``/``v`` may carry fewer heads than
+    ``q`` (``q.shape[1] % k.shape[1] == 0``).
+    """
+    k = repeat_kv(k, q.shape[1])
+    v = repeat_kv(v, q.shape[1])
     b, h, t, d = q.shape
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s / math.sqrt(d)
@@ -94,6 +115,12 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
     while each device accumulates attention of its queries over every
     block — ``n - 1`` ``ppermute`` hops overlapped with compute.
 
+    GQA/MQA: ``k``/``v`` may carry fewer heads than ``q``
+    (``H % H_kv == 0``). The rotating blocks stay in the narrow KV
+    head count, so grouped queries shrink the bytes shipped per ring
+    hop by ``H / H_kv`` — the broadcast to query heads happens only in
+    the local accumulate step.
+
     ``use_flash=True`` runs each block's accumulate step in the Pallas
     kernel (:func:`tpu_p2p.ops.flash_attention.flash_carry_block`) —
     the forward/benchmark fast path; keep the default jnp path for
@@ -126,8 +153,9 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
                 q, k_blk, v_blk, o, m, l, my * t, src_block * t,
                 causal=causal,
             )
-        s = block_mask(_block_scores(q, k_blk, scale), src_block)
-        return _merge(o, m, l, s, v_blk)
+        s = block_mask(_block_scores(q, repeat_kv(k_blk, h), scale),
+                       src_block)
+        return _merge(o, m, l, s, repeat_kv(v_blk, h))
 
     # Local block first (no hop needed)…
     o, m, l = accumulate(o, m, l, k, v, my)

@@ -137,20 +137,53 @@ def _kernel(offs_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
         l_ref[0] = l * alpha + p.sum(axis=-1, keepdims=True)
 
 
+def _gqa_group(bh_q: int, bh_kv: int, q_heads: int) -> int:
+    """Derive and validate the GQA group size from flattened row counts
+    (``B·H_q``, ``B·H_kv``) and the per-batch query head count. Raises
+    on non-divisible head counts — floor division would otherwise send
+    the BlockSpec index maps out of range, which Pallas clamps into
+    silently wrong output."""
+    b = bh_q // q_heads
+    if b * q_heads != bh_q or bh_kv % b:
+        raise ValueError(f"inconsistent shapes: {bh_q=}, {bh_kv=}, {q_heads=}")
+    h_kv = bh_kv // b
+    if q_heads % h_kv:
+        raise ValueError(
+            f"query heads ({q_heads}) must be a multiple of KV heads ({h_kv})"
+        )
+    return q_heads // h_kv
+
+
+def _kv_row_map(q_heads: int, group: int):
+    """Grid row ``i`` (over ``B·H_q``) → row of the narrow KV tensor
+    (over ``B·H_kv``): consecutive query heads within a group share one
+    KV head, so GQA reads K/V straight from HBM with no materialized
+    repeat. Identity when ``group == 1``."""
+    if group == 1:
+        return lambda i: i
+    h_kv = q_heads // group
+    return lambda i: (i // q_heads) * h_kv + (i % q_heads) // group
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_k", "interpret"),
+    static_argnames=("causal", "block_q", "block_k", "q_heads", "interpret"),
 )
 def _flash_call(q3, k3, v3, o0, m0, l0, q_off, k_off, *,
-                causal: bool, block_q: int, block_k: int, interpret: bool):
+                causal: bool, block_q: int, block_k: int, q_heads: int,
+                interpret: bool):
     """One accumulate pass of q3 against the whole of k3/v3.
 
-    Shapes: ``q3 [BH, Tq, D]``, ``k3/v3 [BH, Tk, D]``, carry
-    ``o0 [BH, Tq, D] f32``, ``m0/l0 [BH, Tq] f32``. Returns the updated
-    un-normalized carry; :func:`finalize` divides by ``l``.
+    Shapes: ``q3 [B·H_q, Tq, D]``, ``k3/v3 [B·H_kv, Tk, D]``, carry
+    ``o0 [B·H_q, Tq, D] f32``, ``m0/l0 [B·H_q, Tq] f32``. Returns the
+    updated un-normalized carry; :func:`finalize` divides by ``l``.
+    ``q_heads`` = per-batch query head count, from which the GQA group
+    size is derived (``H_q == H_kv`` → plain MHA).
     """
     bh, tq, d = q3.shape
     tk = k3.shape[1]
+    group = _gqa_group(bh, k3.shape[0], q_heads)
+    kvrow = _kv_row_map(q_heads, group)
     scale = 1.0 / (d ** 0.5)
     offs = jnp.array([q_off, k_off], jnp.int32).reshape(2)
     # m/l as (bh, tq, 1) column vectors: TPU block shapes must have
@@ -167,8 +200,10 @@ def _flash_call(q3, k3, v3, o0, m0, l0, q_off, k_off, *,
         grid=(bh, tq // block_q, tk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kb, s: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb, s: (i, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb, s: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda i, j, kb, s: (kvrow(i), kb, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda i, j, kb, s: (kvrow(i), kb, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j, kb, s: (i, j, 0)),
             pl.BlockSpec((1, block_q, 1), lambda i, j, kb, s: (i, j, 0)),
             pl.BlockSpec((1, block_q, 1), lambda i, j, kb, s: (i, j, 0)),
@@ -224,23 +259,25 @@ def flash_carry_block(q, k, v, o, m, l, q_off, k_off, *,
                       causal: bool = False, interpret=None):
     """Fold one KV block into the carry — the ring-hop compute step.
 
-    ``q [B, H, Tq, D]`` against ``k/v [B, H, Tk, D]`` with global
-    position offsets (traced scalars are fine — they ride scalar
-    prefetch). Carry shapes: ``o [B, H, Tq, D] f32``, ``m/l [B, H, Tq]
-    f32``.
+    ``q [B, H, Tq, D]`` against ``k/v [B, H_kv, Tk, D]`` (GQA: ``H``
+    a multiple of ``H_kv``) with global position offsets (traced
+    scalars are fine — they ride scalar prefetch). Carry shapes:
+    ``o [B, H, Tq, D] f32``, ``m/l [B, H, Tq] f32``.
     """
     b, h, tq, d = q.shape
-    tk = k.shape[2]
+    h_kv, tk = k.shape[1], k.shape[2]
     bh = b * h
     interpret = _interpret_default() if interpret is None else interpret
     bq_blk, bk_blk = _default_blocks(tq, tk, d)
     o3, m3, l3 = _flash_call(
-        q.reshape(bh, tq, d), k.reshape(bh, tk, d), v.reshape(bh, tk, d),
+        q.reshape(bh, tq, d), k.reshape(b * h_kv, tk, d),
+        v.reshape(b * h_kv, tk, d),
         o.reshape(bh, tq, d), m.reshape(bh, tq), l.reshape(bh, tq),
         q_off, k_off,
         causal=causal,
         block_q=bq_blk,
         block_k=bk_blk,
+        q_heads=h,
         interpret=interpret,
     )
     return (
@@ -368,18 +405,24 @@ def _bwd_dq_kernel(offs_ref, k_ref, v_ref, do_ref, L_ref, dl_ref, q_ref,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_k", "interpret"),
+    static_argnames=("causal", "block_q", "block_k", "q_heads", "interpret"),
 )
 def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
-                    causal: bool, block_q: int, block_k: int,
+                    causal: bool, block_q: int, block_k: int, q_heads: int,
                     interpret: bool):
     """dq/dk/dv (f32) for one attention block, FlashAttention-2 style.
 
     ``L [bh, Tq]`` is the forward's logsumexp, ``delta [bh, Tq]`` the
-    precomputed ``rowsum(dO·O)``.
+    precomputed ``rowsum(dO·O)``. GQA (``k3/v3`` with ``B·H_kv`` rows):
+    K/V tiles are read through the narrow-row map; dk/dv come back
+    *per query head* (``B·H_q`` rows) and the caller sums each group —
+    keeping the kernel's output-revisiting pattern identical to MHA at
+    the cost of a factor-``group`` f32 write the XLA-level sum folds.
     """
     bh, tq, d = q3.shape
     tk = k3.shape[1]
+    group = _gqa_group(bh, k3.shape[0], q_heads)
+    kvrow = _kv_row_map(q_heads, group)
     scale = 1.0 / (d ** 0.5)
     offs = jnp.array([q_off, k_off], jnp.int32).reshape(2)
     L = L.reshape(bh, tq, 1)
@@ -390,9 +433,11 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
     )
 
     # Both kernels share block shapes but differ in which middle grid
-    # slot indexes q vs KV; qmap(first/second) picks per call.
-    def qmap(sel):
-        return lambda i, a, b, s: (i, sel(a, b), 0)
+    # slot indexes q vs KV; qmap(first/second) picks per call, and an
+    # optional row map sends the leading grid index through the GQA
+    # narrow-KV mapping.
+    def qmap(sel, row=lambda i: i):
+        return lambda i, a, b, s: (row(i), sel(a, b), 0)
 
     first = lambda a, b: a
     second = lambda a, b: b
@@ -405,8 +450,8 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
             pl.BlockSpec((1, block_q, d), qmap(second)),   # do
             pl.BlockSpec((1, block_q, 1), qmap(second)),   # L
             pl.BlockSpec((1, block_q, 1), qmap(second)),   # delta
-            pl.BlockSpec((1, block_k, d), qmap(first)),    # k
-            pl.BlockSpec((1, block_k, d), qmap(first)),    # v
+            pl.BlockSpec((1, block_k, d), qmap(first, kvrow)),   # k
+            pl.BlockSpec((1, block_k, d), qmap(first, kvrow)),   # v
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), qmap(first)),    # dk (resident)
@@ -432,8 +477,8 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
         num_scalar_prefetch=1,
         grid=(bh, tq // block_q, tk // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_k, d), qmap(second)),   # k
-            pl.BlockSpec((1, block_k, d), qmap(second)),   # v
+            pl.BlockSpec((1, block_k, d), qmap(second, kvrow)),  # k
+            pl.BlockSpec((1, block_k, d), qmap(second, kvrow)),  # v
             pl.BlockSpec((1, block_q, d), qmap(first)),    # do
             pl.BlockSpec((1, block_q, 1), qmap(first)),    # L
             pl.BlockSpec((1, block_q, 1), qmap(first)),    # delta
@@ -463,6 +508,10 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
 def flash_attention(q, k, v, causal: bool = False):
     """Fused single-device attention, ``[B, H, T, D]`` → same.
 
+    GQA/MQA: ``k``/``v`` may be ``[B, H_kv, T, D]`` with
+    ``H % H_kv == 0`` — the kernels read the narrow KV directly (no
+    materialized head repeat) and dk/dv come back in the narrow shape.
+
     Forward runs the Pallas kernel; backward runs the two Pallas
     FlashAttention-2 kernels above, recomputing P from the saved
     logsumexp (O(T) residual memory).
@@ -473,15 +522,18 @@ def flash_attention(q, k, v, causal: bool = False):
 
 def _flash_fwd(q, k, v, causal):
     b, h, t, d = q.shape
+    h_kv = k.shape[1]
     bh = b * h
     bq_blk, bk_blk = _default_blocks(t, t, d)
     o0, m0, l0 = zero_carry(bh, t, d)
     o, m, l = _flash_call(
-        q.reshape(bh, t, d), k.reshape(bh, t, d), v.reshape(bh, t, d),
+        q.reshape(bh, t, d), k.reshape(b * h_kv, t, d),
+        v.reshape(b * h_kv, t, d),
         o0, m0, l0, 0, 0,
         causal=causal,
         block_q=bq_blk,
         block_k=bk_blk,
+        q_heads=h,
         interpret=_interpret_default(),
     )
     out = finalize(o, m, l, q.dtype).reshape(b, h, t, d)
@@ -494,6 +546,7 @@ def _flash_fwd(q, k, v, causal):
 def _flash_bwd(causal, res, g):
     q, k, v, out, L = res
     b, h, t, d = q.shape
+    h_kv = k.shape[1]
     bh = b * h
     # delta = rowsum(dO · O) — cheap elementwise, stays in jnp (XLA
     # fuses it); everything O(T²) runs in the kernels.
@@ -502,18 +555,24 @@ def _flash_bwd(causal, res, g):
     ).reshape(bh, t)
     bq_blk, bk_blk = _bwd_blocks(t, t, d)
     dq, dk, dv = _flash_bwd_call(
-        q.reshape(bh, t, d), k.reshape(bh, t, d), v.reshape(bh, t, d),
+        q.reshape(bh, t, d), k.reshape(b * h_kv, t, d),
+        v.reshape(b * h_kv, t, d),
         g.astype(q.dtype).reshape(bh, t, d), L, delta, 0, 0,
         causal=causal,
         block_q=bq_blk,
         block_k=bk_blk,
+        q_heads=h,
         interpret=_interpret_default(),
     )
-    shape = (b, h, t, d)
+    if h_kv != h:
+        # Kernel output is per query head; fold each GQA group.
+        group = h // h_kv
+        dk = dk.reshape(b, h_kv, group, t, d).sum(2).reshape(b * h_kv, t, d)
+        dv = dv.reshape(b, h_kv, group, t, d).sum(2).reshape(b * h_kv, t, d)
     return (
-        dq.astype(q.dtype).reshape(shape),
-        dk.astype(k.dtype).reshape(shape),
-        dv.astype(v.dtype).reshape(shape),
+        dq.astype(q.dtype).reshape(b, h, t, d),
+        dk.astype(k.dtype).reshape(b, h_kv, t, d),
+        dv.astype(v.dtype).reshape(b, h_kv, t, d),
     )
 
 

@@ -60,12 +60,14 @@ def ulysses_attention_local(q, k, v, axis_name: str, *, causal: bool = False):
     local attention over the full sequence.
     """
     n = jax.lax.axis_size(axis_name)
-    h = q.shape[1]
-    if h % n:
-        raise ValueError(
-            f"Ulysses needs heads ({h}) divisible by axis size ({n}); "
-            "use ring attention for head counts below the mesh axis"
-        )
+    h, h_kv = q.shape[1], k.shape[1]
+    for name, count in (("query heads", h), ("KV heads", h_kv)):
+        if count % n:
+            raise ValueError(
+                f"Ulysses needs {name} ({count}) divisible by axis size "
+                f"({n}); use ring attention below that (GQA rings also "
+                "ship less KV per hop)"
+            )
     qh = _heads_to_seq(q, axis_name)
     kh = _heads_to_seq(k, axis_name)
     vh = _heads_to_seq(v, axis_name)
