@@ -1,0 +1,416 @@
+"""1F1B pipeline parallelism — manual interleaved schedule, bounded memory.
+
+Companion to :mod:`tpu_p2p.models.pipeline` (GPipe). GPipe's training
+step differentiates *through* the schedule scan, so autodiff stashes
+every tick's activations — ``O(M + S)`` microbatch activations per
+stage for ``M`` microbatches over ``S`` stages. The 1F1B (one-forward-
+one-backward, PipeDream-flush) schedule interleaves each stage's
+backward of microbatch ``m`` with the forward of microbatch
+``m + warmup``, so at most ``O(S)`` microbatches are ever in flight:
+this module implements it with *manual* backprop — ``jax.vjp`` per
+stage block inside the tick — and a fixed-size activation stash, so
+peak memory is set by the schedule, not by ``M``.
+
+The reference has no model code at all (its entire program is the
+transport benchmark ``/root/reference/p2p_matrix.cc``); pipeline-stage
+hops are the no-wraparound neighbor ``ppermute`` edge set whose raw
+bandwidth the ``ring`` workload measures (SURVEY.md §2.3).
+
+TPU-first design:
+
+- **Static schedule, computed on the host.** :func:`build_1f1b_schedule`
+  greedily simulates the classic 1F1B policy (warm up with
+  ``min(M, S - s)`` forwards, then strictly alternate B/F, then drain)
+  and emits per-tick integer tables: which microbatch each stage
+  forwards/backwards, and which *stash slot* each activation lives in.
+  Slots are assigned by interval coloring over each activation's
+  lifetime, so the stash is provably minimal for the schedule and every
+  device-side index is data — the compiled program is one ``lax.scan``
+  over a table pytree, no data-dependent control flow.
+- **Rematerialized backward.** The stash holds each stage's *input*
+  activation only; the bwd tick recomputes the block forward under
+  ``jax.vjp`` (same trade as ``jax.checkpoint``). Nothing produced by
+  autodiff crosses tick boundaries.
+- **SPMD masking.** Every device runs the identical tick body; table
+  entries of ``-1`` mask that stage's fwd/bwd contribution to zero,
+  exactly like GPipe's bubble ticks.
+- Activations hop ``s → s+1`` and gradients ``s+1 → s`` through
+  ``ppermute``; a value computed at tick ``t`` is written into the
+  receiver's stash at tick ``t + 1`` (the scan carry is the wire).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_p2p.models.pipeline import (
+    PipelineConfig,
+    _check_pp_mesh,
+    _to_microbatches,
+    mlp_block,
+    pp_param_specs,
+)
+
+Params = Dict[str, jax.Array]
+
+
+@dataclass(frozen=True)
+class Schedule1F1B:
+    """Static 1F1B schedule tables, all ``[T, S]`` int32 (−1 = no op).
+
+    ``f_mb``/``b_mb``: microbatch forwarded / backwarded by stage ``s``
+    at tick ``t``. ``f_slot``/``b_slot``: activation-stash slot the fwd
+    input is written to / read from. ``recv_slot``: slot to store the
+    activation arriving (over the carry) at tick ``t``. ``b_gslot`` /
+    ``grecv_slot``: same pair for the incoming-gradient stash (last
+    stage computes its loss gradient locally and never uses them).
+    """
+
+    num_ticks: int
+    stages: int
+    microbatches: int
+    act_slots: int
+    grad_slots: int
+    f_mb: np.ndarray
+    f_slot: np.ndarray
+    b_mb: np.ndarray
+    b_slot: np.ndarray
+    recv_slot: np.ndarray
+    b_gslot: np.ndarray
+    grecv_slot: np.ndarray
+
+
+def _color_intervals(intervals: List[Tuple[int, int, object]]) -> Tuple[int, Dict]:
+    """Greedy interval coloring: ``(write_tick, last_read_tick, key)`` →
+    ``{key: slot}``. A slot frees strictly *after* its last read tick
+    (no same-tick reuse: received values are written at the top of the
+    tick body, before the bwd read)."""
+    events = sorted(intervals, key=lambda iv: (iv[0], iv[1]))
+    free: List[int] = []
+    busy: List[Tuple[int, int]] = []  # (last_read, slot)
+    assign: Dict = {}
+    n = 0
+    for w, r, key in events:
+        busy.sort()
+        while busy and busy[0][0] < w:
+            free.append(busy.pop(0)[1])
+        if free:
+            slot = free.pop()
+        else:
+            slot = n
+            n += 1
+        busy.append((r, slot))
+        assign[key] = slot
+    return n, assign
+
+
+def build_1f1b_schedule(microbatches: int, stages: int) -> Schedule1F1B:
+    """Simulate the 1F1B policy tick-by-tick and assign stash slots.
+
+    Policy per stage: issue ``min(M, S - s)`` warmup forwards, then
+    strictly alternate backward/forward (idling when the wanted op's
+    input has not arrived), then drain the remaining backwards.
+    """
+    m, s_count = microbatches, stages
+    if m < 1 or s_count < 1:
+        raise ValueError(f"need microbatches >= 1, stages >= 1; got {m}, {s_count}")
+    warmup = [min(m, s_count - s) for s in range(s_count)]
+    next_f = [0] * s_count
+    next_b = [0] * s_count
+    last_kind = [""] * s_count
+    fwd_tick = np.full((s_count, m), -1, np.int64)
+    bwd_tick = np.full((s_count, m), -1, np.int64)
+
+    ops: List[List[Tuple[str, int, int]]] = [[] for _ in range(s_count)]
+    t = 0
+    guard = 4 * (m + s_count) + 8
+    while any(next_b[s] < m for s in range(s_count)):
+        if t > guard:
+            raise RuntimeError(f"1F1B schedule did not converge (M={m}, S={s_count})")
+        for s in range(s_count):
+            # A value produced at tick t' travels over the scan-carry
+            # wire and is usable from tick t'+1, hence the strict
+            # `< t`; the last stage's own forward also feeds its
+            # backward one tick later (stash write → read).
+            def _done_before(tick_tbl, row, mb):
+                return 0 <= tick_tbl[row, mb] < t
+
+            f_ready = next_f[s] < m and (
+                s == 0 or _done_before(fwd_tick, s - 1, next_f[s])
+            )
+            b_ready = next_b[s] < m and (
+                _done_before(bwd_tick, s + 1, next_b[s])
+                if s < s_count - 1
+                else _done_before(fwd_tick, s, next_b[s])
+            )
+            if next_f[s] < warmup[s]:
+                want = "F"
+            elif last_kind[s] == "B" and next_f[s] < m:
+                want = "F"
+            else:
+                want = "B"
+            op = None
+            if want == "F" and f_ready:
+                op = ("F", next_f[s])
+            elif want == "B" and b_ready:
+                op = ("B", next_b[s])
+            elif want == "F" and b_ready and next_f[s] >= m:
+                op = ("B", next_b[s])
+            elif want == "B" and f_ready and next_b[s] >= m:
+                op = ("F", next_f[s])
+            if op is not None:
+                kind, mb = op
+                ops[s].append((kind, mb, t))
+                last_kind[s] = kind
+                if kind == "F":
+                    fwd_tick[s, mb] = t
+                    next_f[s] += 1
+                else:
+                    bwd_tick[s, mb] = t
+                    next_b[s] += 1
+        t += 1
+    num_ticks = t
+
+    f_mb = np.full((num_ticks, s_count), -1, np.int32)
+    b_mb = np.full((num_ticks, s_count), -1, np.int32)
+    for s in range(s_count):
+        for kind, mb, tick in ops[s]:
+            (f_mb if kind == "F" else b_mb)[tick, s] = mb
+
+    # Activation stash: at stage s, microbatch m's input activation is
+    # written at its arrival tick (stage 0: its own fwd tick; else the
+    # upstream fwd tick + 1) and last read at bwd(m, s). Each device
+    # owns a private stash, so slots are colored *per stage* and the
+    # array is sized by the worst stage.
+    act_slots, act_assign = 0, {}
+    grad_slots, grad_assign = 1, {}  # >= 1 keeps shapes non-empty for S == 1
+    for s in range(s_count):
+        act_iv = []
+        for mb in range(m):
+            w = fwd_tick[s, mb] if s == 0 else fwd_tick[s - 1, mb] + 1
+            act_iv.append((int(w), int(bwd_tick[s, mb]), (s, mb)))
+        n, assign = _color_intervals(act_iv)
+        act_slots = max(act_slots, n)
+        act_assign.update(assign)
+        if s < s_count - 1:
+            # Gradient stash: dL/dy arrives at bwd(m, s+1) + 1, read
+            # at bwd(m, s). The last stage computes its own loss grad.
+            grad_iv = [
+                (int(bwd_tick[s + 1, mb] + 1), int(bwd_tick[s, mb]), (s, mb))
+                for mb in range(m)
+            ]
+            n, assign = _color_intervals(grad_iv)
+            grad_slots = max(grad_slots, n)
+            grad_assign.update(assign)
+
+    f_slot = np.full((num_ticks, s_count), -1, np.int32)
+    b_slot = np.full((num_ticks, s_count), -1, np.int32)
+    recv_slot = np.full((num_ticks, s_count), -1, np.int32)
+    b_gslot = np.full((num_ticks, s_count), -1, np.int32)
+    grecv_slot = np.full((num_ticks, s_count), -1, np.int32)
+    for s in range(s_count):
+        for mb in range(m):
+            slot = act_assign[(s, mb)]
+            b_slot[bwd_tick[s, mb], s] = slot
+            f_slot[fwd_tick[s, mb], s] = slot
+            if s > 0:
+                recv_slot[fwd_tick[s - 1, mb] + 1, s] = slot
+            if s < s_count - 1:
+                gs = grad_assign[(s, mb)]
+                b_gslot[bwd_tick[s, mb], s] = gs
+                grecv_slot[bwd_tick[s + 1, mb] + 1, s] = gs
+
+    return Schedule1F1B(
+        num_ticks=num_ticks,
+        stages=s_count,
+        microbatches=m,
+        act_slots=act_slots,
+        grad_slots=grad_slots,
+        f_mb=f_mb,
+        f_slot=f_slot,
+        b_mb=b_mb,
+        b_slot=b_slot,
+        recv_slot=recv_slot,
+        b_gslot=b_gslot,
+        grecv_slot=grecv_slot,
+    )
+
+
+def _sched_tables(sched: Schedule1F1B):
+    """Schedule as a pytree of [T, S] int32 — the scan's xs."""
+    return {
+        "f_mb": jnp.asarray(sched.f_mb),
+        "f_slot": jnp.asarray(sched.f_slot),
+        "b_mb": jnp.asarray(sched.b_mb),
+        "b_slot": jnp.asarray(sched.b_slot),
+        "recv_slot": jnp.asarray(sched.recv_slot),
+        "b_gslot": jnp.asarray(sched.b_gslot),
+        "grecv_slot": jnp.asarray(sched.grecv_slot),
+    }
+
+
+def pipeline_1f1b_grads_local(block_fn: Callable, loss_grad_fn: Callable,
+                              params_local: Params, x_mb, target_mb,
+                              sched: Schedule1F1B, axis: str):
+    """Run the 1F1B schedule — call inside ``shard_map`` over ``axis``.
+
+    ``block_fn(params_local, x) -> y`` is the per-stage compute;
+    ``loss_grad_fn(y, target) -> (loss, dL/dy)`` the last stage's
+    per-microbatch loss (summed, un-normalized). ``x_mb``/``target_mb``:
+    ``[M, mb, ...]`` replicated over ``pp``. Returns
+    ``(loss_sum, dparams_local)`` with loss replicated and dparams the
+    local stage slice — manual backprop, nothing differentiates through
+    the scan.
+    """
+    s_count = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    fwd_edges = [(i, i + 1) for i in range(s_count - 1)]
+    bwd_edges = [(i + 1, i) for i in range(s_count - 1)]
+
+    mb_shape = x_mb.shape[1:]
+    varying = lambda z: jax.lax.pcast(z, (axis,), to="varying")
+    zero_mb = varying(jnp.zeros(mb_shape, x_mb.dtype))
+    x_stash0 = varying(jnp.zeros((sched.act_slots,) + mb_shape, x_mb.dtype))
+    g_stash0 = varying(
+        jnp.zeros((sched.grad_slots,) + mb_shape, jnp.float32)
+    )
+    dparams0 = jax.tree.map(
+        lambda p: varying(jnp.zeros(p.shape, jnp.float32)), params_local
+    )
+
+    def pick(table):  # [S] per-tick row → this device's entry
+        return jax.lax.dynamic_index_in_dim(table, my, 0, keepdims=False)
+
+    def tick(carry, row):
+        x_stash, g_stash, y_recv, g_recv, dparams, loss_acc = carry
+
+        # 1. Stash values that arrived over the carry wire.
+        rs = pick(row["recv_slot"])
+        x_stash = jnp.where(
+            rs >= 0,
+            jax.lax.dynamic_update_index_in_dim(
+                x_stash, y_recv, jnp.clip(rs, 0, sched.act_slots - 1), 0
+            ),
+            x_stash,
+        )
+        gs_in = pick(row["grecv_slot"])
+        g_stash = jnp.where(
+            gs_in >= 0,
+            jax.lax.dynamic_update_index_in_dim(
+                g_stash, g_recv, jnp.clip(gs_in, 0, sched.grad_slots - 1), 0
+            ),
+            g_stash,
+        )
+
+        # 2. Backward: rematerialize the stage forward under vjp.
+        b_mb = pick(row["b_mb"])
+        b_on = b_mb >= 0
+        x_saved = jax.lax.dynamic_index_in_dim(
+            x_stash, jnp.clip(pick(row["b_slot"]), 0, sched.act_slots - 1),
+            0, keepdims=False,
+        )
+        y_re, vjp = jax.vjp(block_fn, params_local, x_saved)
+        tgt = jax.lax.dynamic_index_in_dim(
+            target_mb, jnp.clip(b_mb, 0, sched.microbatches - 1), 0,
+            keepdims=False,
+        )
+        loss_mb, g_loss = loss_grad_fn(y_re, tgt)
+        g_mid = jax.lax.dynamic_index_in_dim(
+            g_stash, jnp.clip(pick(row["b_gslot"]), 0, sched.grad_slots - 1),
+            0, keepdims=False,
+        )
+        g_in = jnp.where(my == s_count - 1, g_loss, g_mid)
+        dp, dx = vjp(g_in.astype(y_re.dtype))
+        # where, not multiply-by-mask: bubble ticks rematerialize over
+        # stale stash contents, and a non-polynomial loss_grad_fn can
+        # emit NaN there — 0 * NaN would still poison the accumulator.
+        dparams = jax.tree.map(
+            lambda a, d: a + jnp.where(b_on, d.astype(jnp.float32), 0.0),
+            dparams, dp,
+        )
+        loss_acc = loss_acc + jnp.where(
+            b_on & (my == s_count - 1), loss_mb.astype(jnp.float32), 0.0
+        )
+        dx = jnp.where(b_on, dx.astype(jnp.float32), 0.0)
+
+        # 3. Forward.
+        f_mb = pick(row["f_mb"])
+        f_on = f_mb >= 0
+        f_slot = jnp.clip(pick(row["f_slot"]), 0, sched.act_slots - 1)
+        feed = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(f_mb, 0, sched.microbatches - 1), 0, keepdims=False
+        )
+        x_in = jnp.where(my == 0, feed,
+                         jax.lax.dynamic_index_in_dim(
+                             x_stash, f_slot, 0, keepdims=False))
+        x_stash = jnp.where(
+            f_on, jax.lax.dynamic_update_index_in_dim(x_stash, x_in, f_slot, 0),
+            x_stash,
+        )
+        y_f = block_fn(params_local, x_in)
+        y_f = jnp.where(f_on, y_f, zero_mb)
+
+        # 4. Ship over the wire for tick t + 1.
+        y_next = (jax.lax.ppermute(y_f, axis, fwd_edges)
+                  if s_count > 1 else zero_mb)
+        g_next = (jax.lax.ppermute(dx, axis, bwd_edges)
+                  if s_count > 1
+                  else varying(jnp.zeros(mb_shape, jnp.float32)))
+
+        return (x_stash, g_stash, y_next, g_next, dparams, loss_acc), None
+
+    g_recv0 = varying(jnp.zeros(mb_shape, jnp.float32))
+    carry0 = (x_stash0, g_stash0, zero_mb, g_recv0, dparams0,
+              varying(jnp.zeros((), jnp.float32)))
+    (_, _, _, _, dparams, loss_acc), _ = jax.lax.scan(
+        tick, carry0, _sched_tables(sched)
+    )
+    # Loss accumulated on the last stage only → replicate across pp.
+    return jax.lax.psum(loss_acc, axis), dparams
+
+
+def _mse_loss_grad(y, target):
+    """(sum-of-squares loss, dL/dy) for one microbatch — matches the
+    GPipe train step's objective (pipeline.py make_pipeline_train_step)."""
+    d = y.astype(jnp.float32) - target.astype(jnp.float32)
+    return jnp.sum(d * d), 2.0 * d
+
+
+def make_pipeline_train_step_1f1b(mesh: Mesh, cfg: PipelineConfig,
+                                  block_fn: Callable = mlp_block,
+                                  lr: float = 1e-2,
+                                  loss_grad_fn: Callable = _mse_loss_grad):
+    """One jitted SGD step under the 1F1B schedule.
+
+    Drop-in equal to :func:`tpu_p2p.models.pipeline.make_pipeline_train_step`
+    (same loss normalization, same update), but with manual interleaved
+    backprop and ``O(S)``-bounded activation memory.
+    """
+    pp = _check_pp_mesh(mesh, cfg)
+    sched = build_1f1b_schedule(cfg.microbatches, cfg.stages)
+
+    def step(params, x, target):
+        x_mb = _to_microbatches(x, cfg.microbatches)
+        t_mb = _to_microbatches(target, cfg.microbatches)
+        loss_sum, grads = pipeline_1f1b_grads_local(
+            block_fn, loss_grad_fn, params, x_mb, t_mb, sched, pp
+        )
+        denom = float(np.prod(x.shape))
+        new_params = jax.tree.map(
+            lambda p, g: (p.astype(jnp.float32) - lr * g / denom).astype(p.dtype),
+            params, grads,
+        )
+        return new_params, loss_sum / denom
+
+    sm = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(pp_param_specs(mesh), P(), P()),
+        out_specs=(pp_param_specs(mesh), P()),
+    )
+    return jax.jit(sm)

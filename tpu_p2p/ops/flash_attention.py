@@ -59,6 +59,11 @@ def _default_blocks(tq: int, tk: int, d: int) -> Tuple[int, int]:
     set (q + o f32 + double-buffered k/v) stays inside the ~16 MiB
     VMEM budget."""
     pref = max(128, 1024 * 128 // max(d, 128))
+    # Re-swept on the v5e (measured ~106-115 TFLOP/s causal fwd at
+    # T=16k/D=128, run-to-run ±10% behind the relay): (1024, 1024)
+    # remains optimal — (512,1024)/(1024,512) lose ~25%, (1024,2048)
+    # halves throughput, bq>=2048 fails to compile (VMEM), and
+    # dimension_semantics hints measured no gain over the default.
     return _pick_block(tq, pref), _pick_block(tk, pref)
 
 
