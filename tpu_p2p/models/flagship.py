@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, replace
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -73,6 +73,10 @@ class FlagshipConfig:
     sp_strategy: str = "ring"  # "ring" (ppermute KV rotation) or
     # "ulysses" (head<->seq all_to_all) — the two SP families of
     # SURVEY.md §2.3; ulysses needs heads % sp == 0
+    zero_dp: bool = False    # ZeRO-3/FSDP: params (and thus grads +
+    # optimizer moments) sharded over dp, all-gathered on use inside
+    # the step; autodiff turns the gather's transpose into the ZeRO
+    # gradient reduce-scatter. See tpu_p2p/parallel/fsdp.py.
 
     @property
     def model_dim(self) -> int:
@@ -121,29 +125,40 @@ def _axis(mesh: Mesh, name: str):
     return name if name in mesh.axis_names else None
 
 
-def init_flagship_params(cfg: FlagshipConfig, seed: int = 0) -> Params:
-    rng = np.random.default_rng(seed)
+def flagship_param_shapes(cfg: FlagshipConfig) -> Dict[str, Tuple[int, ...]]:
+    """Parameter shapes from the config alone (no initialization) —
+    feeds the static FSDP plan and checkpoint metadata."""
     s, h, hkv = cfg.stages, cfg.heads, cfg.num_kv_heads
     dm, dh = cfg.model_dim, cfg.head_dim
     e, f = cfg.num_experts, cfg.moe_mult * cfg.model_dim
-    dtype = jnp.dtype(cfg.dtype)
-
-    def w(*shape, fan_in):
-        return jnp.asarray(rng.standard_normal(shape) / math.sqrt(fan_in),
-                           dtype=dtype)
-
     return {
-        "wq": w(s, h, dm, dh, fan_in=dm),
-        "wk": w(s, hkv, dm, dh, fan_in=dm),
-        "wv": w(s, hkv, dm, dh, fan_in=dm),
-        "wo": w(s, h, dh, dm, fan_in=dh),
-        "router": w(s, dm, e, fan_in=dm),
-        "we1": w(s, e, dm, f, fan_in=dm),
-        "we2": w(s, e, f, dm, fan_in=f),
+        "wq": (s, h, dm, dh),
+        "wk": (s, hkv, dm, dh),
+        "wv": (s, hkv, dm, dh),
+        "wo": (s, h, dh, dm),
+        "router": (s, dm, e),
+        "we1": (s, e, dm, f),
+        "we2": (s, e, f, dm),
     }
 
 
-def flagship_param_specs(mesh: Mesh) -> Dict[str, P]:
+_FAN_IN_DIM = {"wq": 2, "wk": 2, "wv": 2, "wo": 2, "router": 1,
+               "we1": 2, "we2": 2}
+
+
+def init_flagship_params(cfg: FlagshipConfig, seed: int = 0) -> Params:
+    rng = np.random.default_rng(seed)
+    dtype = jnp.dtype(cfg.dtype)
+    return {
+        name: jnp.asarray(
+            rng.standard_normal(shape) / math.sqrt(shape[_FAN_IN_DIM[name]]),
+            dtype=dtype,
+        )
+        for name, shape in flagship_param_shapes(cfg).items()
+    }
+
+
+def _base_param_specs(mesh: Mesh) -> Dict[str, P]:
     pp, tp, ep = _axis(mesh, "pp"), _axis(mesh, "tp"), _axis(mesh, "ep")
     return {
         "wq": P(pp, tp, None, None),
@@ -154,6 +169,30 @@ def flagship_param_specs(mesh: Mesh) -> Dict[str, P]:
         "we1": P(pp, ep, None, None),
         "we2": P(pp, ep, None, None),
     }
+
+
+def _fsdp_plan(mesh: Mesh, cfg: Optional[FlagshipConfig]):
+    """The static ZeRO plan, or None when FSDP is off / inapplicable."""
+    from tpu_p2p.parallel import fsdp
+
+    if cfg is None or not cfg.zero_dp or _axis(mesh, "dp") is None:
+        return None
+    plan = fsdp.fsdp_plan(
+        flagship_param_shapes(cfg), _base_param_specs(mesh),
+        mesh.shape["dp"],
+    )
+    return plan if any(d is not None for d in plan.values()) else None
+
+
+def flagship_param_specs(mesh: Mesh,
+                         cfg: Optional[FlagshipConfig] = None) -> Dict[str, P]:
+    """Param shardings: pp stage-major, tp heads, ep experts — plus the
+    dp dim from the ZeRO plan when ``cfg.zero_dp`` is set."""
+    from tpu_p2p.parallel import fsdp
+
+    base = _base_param_specs(mesh)
+    plan = _fsdp_plan(mesh, cfg)
+    return fsdp.fsdp_specs(base, plan, "dp") if plan else base
 
 
 def flagship_data_spec(mesh: Mesh) -> P:
@@ -245,14 +284,19 @@ def _mesh_axes(mesh: Mesh) -> Dict[str, str]:
 
 def make_flagship_forward(mesh: Mesh, cfg: FlagshipConfig):
     """Jitted forward over the 5-axis mesh: global [B, T, Dm] → same."""
+    from tpu_p2p.parallel import fsdp
+
     axes = _mesh_axes(mesh)
+    plan = _fsdp_plan(mesh, cfg)
 
     def f(params, x):
+        if plan:
+            params = fsdp.all_gather_params(params, "dp", plan)
         return _forward_local(params, x, cfg, axes)
 
     sm = jax.shard_map(
         f, mesh=mesh,
-        in_specs=(flagship_param_specs(mesh), flagship_data_spec(mesh)),
+        in_specs=(flagship_param_specs(mesh, cfg), flagship_data_spec(mesh)),
         out_specs=flagship_data_spec(mesh),
     )
     return jax.jit(sm)
@@ -267,10 +311,19 @@ def make_flagship_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
     come back sharded exactly like the params, so any optimizer's
     elementwise update runs shard-local under ``jit``.
     """
+    from tpu_p2p.parallel import fsdp
+
     axes = _mesh_axes(mesh)
+    plan = _fsdp_plan(mesh, cfg)
+    specs = flagship_param_specs(mesh, cfg)
 
     def gstep(params, x, target):
         def local_loss(p):
+            # ZeRO gather-on-use sits inside the differentiated
+            # function: its transpose is the gradient psum_scatter, so
+            # grads come back dp-sharded like the params.
+            if plan:
+                p = fsdp.all_gather_params(p, "dp", plan)
             out = _forward_local(p, x, cfg, axes)
             return jnp.sum(
                 (out.astype(jnp.float32) - target.astype(jnp.float32)) ** 2
@@ -286,9 +339,8 @@ def make_flagship_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
 
     sm = jax.shard_map(
         gstep, mesh=mesh,
-        in_specs=(flagship_param_specs(mesh), flagship_data_spec(mesh),
-                  flagship_data_spec(mesh)),
-        out_specs=(flagship_param_specs(mesh), P()),
+        in_specs=(specs, flagship_data_spec(mesh), flagship_data_spec(mesh)),
+        out_specs=(specs, P()),
     )
     return jax.jit(sm)
 
@@ -338,13 +390,37 @@ def make_flagship_optax_step(mesh: Mesh, cfg: FlagshipConfig, tx):
 
 
 def init_optimizer(tx, params: Params):
-    """``tx.init`` under jit so the opt state inherits the params'
-    shardings (moments land shard-local, not replicated)."""
-    return jax.jit(tx.init)(params)
+    """``tx.init`` with the optimizer state explicitly sharded like the
+    params: per-param moments (mu/nu/trace…) get that param's sharding,
+    everything else (step counts) is replicated. jit alone does NOT do
+    this — sharding propagation through a broadcast-of-zeros picks a
+    default placement, which would silently replicate ZeRO moments.
+
+    Leaves are matched to params by tree path: optax state subtrees
+    mirror the params dict, so the innermost dict key naming a param
+    (with matching shape) identifies its sharding.
+    """
+    shardings = {k: getattr(v, "sharding", None) for k, v in params.items()}
+    if any(not isinstance(s, NamedSharding) for s in shardings.values()):
+        return jax.jit(tx.init)(params)  # unplaced params: plain init
+    mesh = next(iter(shardings.values())).mesh
+    replicated = NamedSharding(mesh, P())
+
+    def leaf_sharding(path, leaf):
+        for entry in reversed(path):
+            name = getattr(entry, "key", None)
+            if name in params and leaf.shape == params[name].shape:
+                return shardings[name]
+        return replicated
+
+    shapes = jax.eval_shape(tx.init, params)
+    out_shardings = jax.tree_util.tree_map_with_path(leaf_sharding, shapes)
+    return jax.jit(tx.init, out_shardings=out_shardings)(params)
 
 
-def place_flagship_params(params: Params, mesh: Mesh) -> Params:
-    specs = flagship_param_specs(mesh)
+def place_flagship_params(params: Params, mesh: Mesh,
+                          cfg: Optional[FlagshipConfig] = None) -> Params:
+    specs = flagship_param_specs(mesh, cfg)
     return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
             for k, v in params.items()}
 

@@ -127,7 +127,6 @@ def build_1f1b_schedule(microbatches: int, stages: int) -> Schedule1F1B:
     fwd_tick = np.full((s_count, m), -1, np.int64)
     bwd_tick = np.full((s_count, m), -1, np.int64)
 
-    ops: List[List[Tuple[str, int, int]]] = [[] for _ in range(s_count)]
     t = 0
     guard = 4 * (m + s_count) + 8
     while any(next_b[s] < m for s in range(s_count)):
@@ -155,33 +154,23 @@ def build_1f1b_schedule(microbatches: int, stages: int) -> Schedule1F1B:
                 want = "F"
             else:
                 want = "B"
-            op = None
             if want == "F" and f_ready:
-                op = ("F", next_f[s])
+                last_kind[s] = "F"
+                fwd_tick[s, next_f[s]] = t
+                next_f[s] += 1
             elif want == "B" and b_ready:
-                op = ("B", next_b[s])
-            elif want == "F" and b_ready and next_f[s] >= m:
-                op = ("B", next_b[s])
-            elif want == "B" and f_ready and next_b[s] >= m:
-                op = ("F", next_f[s])
-            if op is not None:
-                kind, mb = op
-                ops[s].append((kind, mb, t))
-                last_kind[s] = kind
-                if kind == "F":
-                    fwd_tick[s, mb] = t
-                    next_f[s] += 1
-                else:
-                    bwd_tick[s, mb] = t
-                    next_b[s] += 1
+                last_kind[s] = "B"
+                bwd_tick[s, next_b[s]] = t
+                next_b[s] += 1
         t += 1
     num_ticks = t
 
     f_mb = np.full((num_ticks, s_count), -1, np.int32)
     b_mb = np.full((num_ticks, s_count), -1, np.int32)
     for s in range(s_count):
-        for kind, mb, tick in ops[s]:
-            (f_mb if kind == "F" else b_mb)[tick, s] = mb
+        for mb in range(m):
+            f_mb[fwd_tick[s, mb], s] = mb
+            b_mb[bwd_tick[s, mb], s] = mb
 
     # Activation stash: at stage s, microbatch m's input activation is
     # written at its arrival tick (stage 0: its own fwd tick; else the
