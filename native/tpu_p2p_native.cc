@@ -16,6 +16,14 @@
 //    percentiles and one-pass stats over per-iteration samples (the
 //    reference keeps only a mean, p2p_matrix.cc:176; BASELINE.json's
 //    p50 metric needs more).
+//  - tpu_p2p_check_placement: the L2 placement-policy check
+//    (p2p_matrix.cc:63-100) over an array of host keys — uniform
+//    devices per host + contiguous per-host rank blocks.
+//  - tpu_p2p_gbps: the L6 throughput formula bytes*8/t/1e9, with the
+//    bi-directional ×2 (p2p_matrix.cc:177,258).
+//  - tpu_p2p_format_header / _format_cell / _format_row_label: the L7
+//    matrix byte format ("   D\D" + "%6d " ids, "%6.02f " cells —
+//    p2p_matrix.cc:134-139,143,179) as snprintf parity.
 //
 // Exposed via a C ABI for ctypes (pybind11 is unavailable in this
 // image). Build: `make native` → native/libtpu_p2p_native.so.
@@ -24,6 +32,7 @@
 #include <cmath>
 #include <cstddef>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <ctime>
 #include <vector>
@@ -98,6 +107,74 @@ void tpu_p2p_stats(const double* samples, size_t n, double* out) {
   out[2] = s.back();
   out[3] = nearest_rank(50.0);
   out[4] = nearest_rank(99.0);
+}
+
+// L2 placement-policy check (p2p_matrix.cc:63-100). host_keys[i] is an
+// opaque host id for global device i (hostname hash in the reference,
+// process_index under JAX). Returns the local device id of `rank`
+// (rank % devices_per_host, p2p_matrix.cc:99) on success,
+// -1 when hosts are non-uniform (:83-86), -2 when a host's ranks are
+// not a contiguous block (:88-98), -3 on bad arguments.
+int tpu_p2p_check_placement(const uint64_t* host_keys, int n, int rank) {
+  if (n <= 0 || rank < 0 || rank >= n) return -3;
+  // Distinct host count, preserving first-seen order (set semantics of
+  // the reference's :78-82 loop).
+  std::vector<uint64_t> distinct;
+  for (int i = 0; i < n; ++i) {
+    bool seen = false;
+    for (uint64_t h : distinct) seen = seen || (h == host_keys[i]);
+    if (!seen) distinct.push_back(host_keys[i]);
+  }
+  const int num_hosts = static_cast<int>(distinct.size());
+  if (n % num_hosts != 0) return -1;
+  const int per_host = n / num_hosts;
+  for (int host = 0; host < num_hosts; ++host) {
+    const int base = host * per_host;
+    for (int k = 1; k < per_host; ++k) {
+      if (host_keys[base + k] != host_keys[base + k - 1]) return -2;
+    }
+  }
+  return rank % per_host;
+}
+
+// L6 throughput formula (p2p_matrix.cc:177): Gbps = bytes*8/t/1e9,
+// doubled for bi-directional sweeps (:258). NaN on non-positive time.
+double tpu_p2p_gbps(uint64_t msg_bytes, double seconds, int bidir) {
+  if (seconds <= 0.0) return NAN;
+  double g = static_cast<double>(msg_bytes) * 8.0 / seconds / 1e9;
+  return bidir ? 2.0 * g : g;
+}
+
+// L7 matrix byte format. Each returns the number of bytes written
+// (excluding the NUL), or -1 if `cap` is too small.
+
+// Title line + "   D\D" + "%6d "-formatted ids + newline
+// (p2p_matrix.cc:134-139).
+long tpu_p2p_format_header(const char* title, int n, char* buf, size_t cap) {
+  size_t off = 0;
+  int w = snprintf(buf, cap, "%s\n   D\\D", title);
+  if (w < 0 || static_cast<size_t>(w) >= cap) return -1;
+  off += static_cast<size_t>(w);
+  for (int i = 0; i < n; ++i) {
+    w = snprintf(buf + off, cap - off, "%6d ", i);
+    if (w < 0 || off + static_cast<size_t>(w) >= cap) return -1;
+    off += static_cast<size_t>(w);
+  }
+  w = snprintf(buf + off, cap - off, "\n");
+  if (w < 0 || off + static_cast<size_t>(w) >= cap) return -1;
+  return static_cast<long>(off + static_cast<size_t>(w));
+}
+
+// One "%6.02f "-formatted cell (p2p_matrix.cc:179).
+long tpu_p2p_format_cell(double value, char* buf, size_t cap) {
+  int w = snprintf(buf, cap, "%6.02f ", value);
+  return (w < 0 || static_cast<size_t>(w) >= cap) ? -1 : w;
+}
+
+// "%6d "-formatted row label (p2p_matrix.cc:143).
+long tpu_p2p_format_row_label(int src, char* buf, size_t cap) {
+  int w = snprintf(buf, cap, "%6d ", src);
+  return (w < 0 || static_cast<size_t>(w) >= cap) ? -1 : w;
 }
 
 }  // extern "C"

@@ -61,3 +61,92 @@ def test_stats_native():
 def test_stats_empty_fallback():
     s = native.stats([])
     assert all(math.isnan(v) for v in s.values())
+
+
+@requires_native
+def test_check_placement_parity_and_errors():
+    from tpu_p2p.utils.errors import PlacementError
+
+    # Valid contiguous 2-host placement: local id = rank % per_host.
+    keys = [7, 7, 7, 9, 9, 9]
+    for rank in range(6):
+        want = topology.validate_placement(keys).local_id(rank)
+        assert native.check_placement(keys, rank) == want, rank
+    # Non-uniform host sizes (5 devices, 2 hosts).
+    with pytest.raises(PlacementError, match="same number"):
+        native.check_placement([7, 7, 7, 9, 9], 0)
+    # Interleaved (non-contiguous) placement.
+    with pytest.raises(PlacementError, match="contiguous"):
+        native.check_placement([7, 9, 7, 9], 0)
+    with pytest.raises(PlacementError):
+        native.check_placement([], 0)
+    with pytest.raises(PlacementError):
+        native.check_placement([7], 3)
+
+
+@requires_native
+def test_gbps_formula_parity():
+    # p2p_matrix.cc:177: 32MiB in 1ms → 268.44 Gbps; bi-dir ×2 (:258).
+    msg = 32 * 1024 * 1024
+    assert native.gbps(msg, 1e-3) == pytest.approx(msg * 8 / 1e-3 / 1e9)
+    assert native.gbps(msg, 1e-3, bidir=True) == pytest.approx(
+        2 * msg * 8 / 1e-3 / 1e9
+    )
+    assert math.isnan(native.gbps(msg, 0.0))
+
+
+@requires_native
+def test_native_formatting_byte_parity_with_printf():
+    # The exact reference strings: "%6d " ids/labels, "%6.02f " cells.
+    assert native.format_header("Title", 3) == (
+        "Title\n   D\\D" + "".join("%6d " % i for i in range(3)) + "\n"
+    )
+    for v in (0.0, 0.004, 3.14159, 123.456, 99999.9, float("nan")):
+        got = native.format_cell(v)
+        assert got == "%6.02f " % v, (v, got)
+    for s in (0, 7, 42, 100000):
+        assert native.format_row_label(s) == "%6d " % s
+
+
+@requires_native
+def test_matrix_reporter_output_identical_with_and_without_native(monkeypatch):
+    import io
+
+    from tpu_p2p.utils.report import MatrixReporter
+
+    def render():
+        buf = io.StringIO()
+        r = MatrixReporter(3, "Evaluating X", stream=buf)
+        r.header()
+        for i in range(3):
+            r.row_label(i)
+            for j in range(3):
+                r.diagonal(i) if i == j else r.cell(i, j, 10.0 * i + j)
+            r.end_row()
+        return buf.getvalue()
+
+    with_native = render()
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    without_native = render()
+    assert with_native == without_native
+
+
+def test_check_placement_fallback_matches_native_contract(monkeypatch):
+    """Bad ranks and bad placements raise identically with the lib
+    absent (the review-found fallback divergence)."""
+    from tpu_p2p.utils.errors import PlacementError
+
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    assert native.check_placement([7, 7, 9, 9], 3) == 1
+    with pytest.raises(PlacementError):
+        native.check_placement([7, 7, 9, 9], -1)
+    with pytest.raises(PlacementError):
+        native.check_placement([7], 3)
+    with pytest.raises(PlacementError, match="same number"):
+        native.check_placement([7, 7, 7, 9, 9], 0)
+    assert math.isnan(native.gbps(1024, 0.0))
+    assert native.gbps(1024, 1e-3, bidir=True) == pytest.approx(
+        2 * 1024 * 8 / 1e-3 / 1e9
+    )

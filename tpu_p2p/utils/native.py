@@ -65,6 +65,29 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_double),
             ]
             lib.tpu_p2p_stats.restype = None
+            lib.tpu_p2p_check_placement.argtypes = [
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_int,
+                ctypes.c_int,
+            ]
+            lib.tpu_p2p_check_placement.restype = ctypes.c_int
+            lib.tpu_p2p_gbps.argtypes = [
+                ctypes.c_uint64, ctypes.c_double, ctypes.c_int,
+            ]
+            lib.tpu_p2p_gbps.restype = ctypes.c_double
+            lib.tpu_p2p_format_header.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.tpu_p2p_format_header.restype = ctypes.c_long
+            lib.tpu_p2p_format_cell.argtypes = [
+                ctypes.c_double, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.tpu_p2p_format_cell.restype = ctypes.c_long
+            lib.tpu_p2p_format_row_label.argtypes = [
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.tpu_p2p_format_row_label.restype = ctypes.c_long
             _lib = lib
             break
         except OSError:
@@ -114,6 +137,75 @@ def percentile(samples: Sequence[float], q: float) -> float:
         return math.nan
     rank = max(0, min(len(s) - 1, math.ceil(q / 100.0 * len(s)) - 1))
     return s[rank]
+
+
+def check_placement(host_keys: Sequence[int], rank: int) -> int:
+    """Local device id for ``rank``, or raise on a bad placement —
+    native twin of :func:`tpu_p2p.parallel.topology.validate_placement`
+    (the reference's ``check_process_placement_policy``,
+    ``p2p_matrix.cc:63-100``). Both paths raise the same messages."""
+    from tpu_p2p.parallel import topology
+    from tpu_p2p.utils.errors import PlacementError
+
+    if not 0 <= rank < len(host_keys):
+        raise PlacementError(
+            f"bad placement arguments: n={len(host_keys)}, {rank=}"
+        )
+    lib = _load()
+    if lib is None:
+        return topology.validate_placement(host_keys).local_id(rank)
+    arr = (ctypes.c_uint64 * len(host_keys))(*host_keys)
+    r = int(lib.tpu_p2p_check_placement(arr, len(host_keys), rank))
+    if r == -1:
+        raise PlacementError(topology._MSG_NONUNIFORM)
+    if r == -2:
+        raise PlacementError(topology._MSG_NONCONTIGUOUS)
+    return r
+
+
+def gbps(msg_bytes: int, seconds: float, bidir: bool = False) -> float:
+    """Gbps = bytes*8/t/1e9, ×2 for bi-dir (``p2p_matrix.cc:177,258``).
+
+    Native twin of :func:`tpu_p2p.utils.timing.gbps` (the production
+    formula); the fallback delegates there so there is one source of
+    truth per language."""
+    lib = _load()
+    if lib is not None:
+        return float(lib.tpu_p2p_gbps(msg_bytes, seconds, int(bidir)))
+    from tpu_p2p.utils import timing
+
+    return timing.gbps(msg_bytes, seconds, directions=2 if bidir else 1)
+
+
+def format_header(title: str, n: int) -> Optional[str]:
+    """The matrix title + ``D\\D`` header line, natively formatted;
+    None when the library is unbuilt (callers fall back to Python)."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = ctypes.create_string_buffer(64 + 7 * n)
+    w = lib.tpu_p2p_format_header(title.encode(), n, buf, len(buf))
+    return buf.raw[:w].decode() if w > 0 else None
+
+
+def format_cell(value: float) -> Optional[str]:
+    """One ``%6.02f`` cell, natively formatted; None when unbuilt."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = ctypes.create_string_buffer(64)
+    w = lib.tpu_p2p_format_cell(value, buf, len(buf))
+    return buf.raw[:w].decode() if w > 0 else None
+
+
+def format_row_label(src: int) -> Optional[str]:
+    """One ``%6d`` row label, natively formatted; None when unbuilt."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = ctypes.create_string_buffer(64)
+    w = lib.tpu_p2p_format_row_label(src, buf, len(buf))
+    return buf.raw[:w].decode() if w > 0 else None
 
 
 def stats(samples: Sequence[float]) -> dict:
