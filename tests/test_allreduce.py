@@ -1,0 +1,115 @@
+"""allreduce / reduce_scatter workloads: collective semantics vs host
+oracles, byte accounting, CLI registration, and payload verification."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_p2p.config import BenchConfig
+from tpu_p2p.parallel import collectives as C
+from tpu_p2p.utils.errors import BackendError
+from tpu_p2p.workloads.allreduce import run_allreduce, run_reduce_scatter
+from tpu_p2p.workloads.base import WorkloadContext
+
+
+def _ctx(rt, **kw):
+    kw.setdefault("pattern", "allreduce")
+    kw.setdefault("iters", 2)
+    kw.setdefault("warmup", 1)
+    return WorkloadContext(rt=rt, cfg=BenchConfig(**kw))
+
+
+# --------------------------------------------------------------- semantics
+
+
+def test_psum_matches_host_oracle(rt):
+    x = C.make_payload(rt.mesh, 256)
+    got = np.asarray(C.CollectiveCache().all_reduce(rt.mesh, "d")(x))
+    np.testing.assert_array_equal(got, C.expected_all_reduce(np.asarray(x)))
+
+
+def test_psum_int8_wraparound_matches_numpy(rt):
+    # 8 rank-tagged int8 rows sum past ±127 — both sides must wrap.
+    x = C.make_payload(rt.mesh, 1024)
+    host = C.expected_all_reduce(np.asarray(x))
+    assert host.dtype == np.int8
+    got = np.asarray(C.CollectiveCache().all_reduce(rt.mesh, "d")(x))
+    np.testing.assert_array_equal(got, host)
+
+
+def test_reduce_scatter_matches_host_oracle(rt):
+    x = C.make_payload(rt.mesh, 512)  # 512 elems / 8 devices = 64 each
+    got = np.asarray(C.CollectiveCache().reduce_scatter(rt.mesh, "d")(x))
+    want = C.expected_reduce_scatter(np.asarray(x))
+    assert got.shape == want.shape
+    np.testing.assert_array_equal(got, want)
+
+
+def test_rs_ag_chain_is_iterated_allreduce(rt):
+    x = C.make_payload(rt.mesh, 512)
+    got = np.asarray(C.CollectiveCache().rs_ag_chain(rt.mesh, "d", 2)(x))
+    host = C.expected_all_reduce(C.expected_all_reduce(np.asarray(x)))
+    np.testing.assert_array_equal(got, host)
+
+
+def test_psum_chain_composes(rt):
+    x = C.make_payload(rt.mesh, 256)
+    got = np.asarray(C.CollectiveCache().psum_chain(rt.mesh, "d", 3)(x))
+    host = np.asarray(x)
+    for _ in range(3):
+        host = C.expected_all_reduce(host)
+    np.testing.assert_array_equal(got, host)
+
+
+# --------------------------------------------------------------- workloads
+
+
+@pytest.mark.parametrize("mode", ["serialized", "fused", "differential"])
+def test_allreduce_workload_runs_all_modes(rt, mode, capsys):
+    # differential needs a non-trivial chain-length delta, or CPU noise
+    # can yield a negative slope (reported as NaN by design).
+    iters = 32 if mode == "differential" else 2
+    res = run_allreduce(_ctx(rt, pattern="allreduce", msg_size=4096,
+                             mode=mode, iters=iters, check=True))
+    assert len(res) == 1 and np.isfinite(res[0]["gbps_per_device"])
+    assert "allreduce 4KiB" in capsys.readouterr().out
+
+
+@pytest.mark.parametrize("mode", ["serialized", "differential"])
+def test_reduce_scatter_workload_runs(rt, mode, capsys):
+    iters = 32 if mode == "differential" else 2
+    res = run_reduce_scatter(_ctx(rt, pattern="reduce_scatter",
+                                  msg_size=4096, mode=mode, iters=iters,
+                                  check=True))
+    assert len(res) == 1 and np.isfinite(res[0]["gbps_per_device"])
+    out = capsys.readouterr().out
+    assert "reduce_scatter 4KiB" in out
+
+
+def test_reduce_scatter_rejects_undividable_payload(rt):
+    with pytest.raises(BackendError, match="divisible"):
+        run_reduce_scatter(_ctx(rt, pattern="reduce_scatter", msg_size=4))
+
+
+def test_reduction_jsonl_records(rt, tmp_path):
+    from tpu_p2p.utils.report import JsonlWriter
+
+    path = str(tmp_path / "cells.jsonl")
+    ctx = _ctx(rt, pattern="allreduce", msg_size=2048)
+    ctx.jsonl = JsonlWriter(path)
+    run_allreduce(ctx)
+    ctx.jsonl.close()
+    recs = [json.loads(line) for line in open(path)]
+    assert recs and recs[0]["workload"] == "allreduce"
+    assert recs[0]["devices"] == rt.num_devices
+    assert "2(n-1)/n" in recs[0]["accounting"]
+
+
+def test_cli_runs_reduction_patterns():
+    from tpu_p2p.cli import main
+
+    assert main(["--pattern", "allreduce", "--msg-size", "2KiB",
+                 "--iters", "2"]) == 0
+    assert main(["--pattern", "reduce_scatter", "--msg-size", "2KiB",
+                 "--iters", "2", "--mode", "differential"]) == 0

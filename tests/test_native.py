@@ -150,3 +150,12 @@ def test_check_placement_fallback_matches_native_contract(monkeypatch):
     assert native.gbps(1024, 1e-3, bidir=True) == pytest.approx(
         2 * 1024 * 8 / 1e-3 / 1e9
     )
+
+
+@requires_native
+def test_format_header_long_title_stays_native():
+    # Buffer is sized from the title — a 56+ char title must not fall
+    # back to Python (review finding: fixed slack was exactly 55).
+    long_title = "x" * 200
+    got = native.format_header(long_title, 8)
+    assert got is not None and got.startswith(long_title + "\n   D\\D")

@@ -246,8 +246,126 @@ class CollectiveCache:
 
         return self._get(key, build)
 
+    # -- reductions ------------------------------------------------------
+
+    def all_reduce(self, mesh: Mesh, axis: str):
+        """One ``psum`` of the payload over ``axis`` — the data-parallel
+        gradient transport (SURVEY.md §2.3 DP row). Absent from the
+        reference (no gradients exist there); named here because its
+        ring decomposition moves exactly the reduce-scatter +
+        all-gather bytes this benchmark family measures."""
+        key = ("allreduce", mesh, axis)
+
+        def build():
+            spec = P(*mesh.axis_names, None)
+
+            def f(x):
+                return jax.lax.psum(x, axis)
+
+            return jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+            )
+
+        return self._get(key, build)
+
+    def psum_chain(self, mesh: Mesh, axis: str, count: int):
+        """``count`` data-dependent ``psum``\\ s in one program (the
+        fused/differential timing unit; values wrap in integer dtypes,
+        which is irrelevant to transport timing)."""
+        key = ("psum_chain", mesh, axis, count)
+
+        def build():
+            spec = P(*mesh.axis_names, None)
+
+            def f(x):
+                def step(carry, _):
+                    # psum output is typed unvarying over `axis`; the
+                    # recast keeps the scan carry type fixed.
+                    return jax.lax.pcast(jax.lax.psum(carry, axis),
+                                         (axis,), to="varying"), None
+
+                out, _ = jax.lax.scan(step, x, None, length=count)
+                return out
+
+            return jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+            )
+
+        return self._get(key, build)
+
+    def reduce_scatter(self, mesh: Mesh, axis: str):
+        """One tiled ``psum_scatter`` along the payload dim — the ZeRO
+        gradient transport (tpu_p2p/parallel/fsdp.py): device ``j``
+        keeps chunk ``j`` of the sum. Payload elems must divide by the
+        axis size."""
+        key = ("rs", mesh, axis)
+
+        def build():
+            spec = P(*mesh.axis_names, None)
+
+            def f(x):
+                return jax.lax.psum_scatter(
+                    x, axis, scatter_dimension=x.ndim - 1, tiled=True
+                )
+
+            return jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+            )
+
+        return self._get(key, build)
+
+    def rs_ag_chain(self, mesh: Mesh, axis: str, count: int):
+        """``count`` hops of ``psum_scatter`` + tiled ``all_gather``
+        (shape-preserving, so it chains under ``scan``) — the explicit
+        ring decomposition of one allreduce per hop, and the
+        fused/differential unit for the reduce_scatter workload."""
+        key = ("rs_ag_chain", mesh, axis, count)
+
+        def build():
+            spec = P(*mesh.axis_names, None)
+
+            def f(x):
+                def step(carry, _):
+                    rs = jax.lax.psum_scatter(
+                        carry, axis, scatter_dimension=carry.ndim - 1,
+                        tiled=True,
+                    )
+                    return jax.lax.all_gather(
+                        rs, axis, axis=rs.ndim - 1, tiled=True
+                    ), None
+
+                out, _ = jax.lax.scan(step, x, None, length=count)
+                return out
+
+            return jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+            )
+
+        return self._get(key, build)
+
     def __len__(self) -> int:
         return len(self._cache)
+
+
+def expected_all_reduce(x: np.ndarray) -> np.ndarray:
+    """Host semantics of the payload psum: every row becomes the
+    elementwise sum over rows, with native integer wraparound (XLA and
+    numpy both wrap two's-complement)."""
+    out = x[0].copy()
+    for r in range(1, x.shape[0]):
+        out = out + x[r]  # stepwise, preserving the dtype's wraparound
+    return np.broadcast_to(out, x.shape).copy()
+
+
+def expected_reduce_scatter(x: np.ndarray) -> np.ndarray:
+    """Host semantics of the tiled psum_scatter over a flat-mesh
+    payload ``[n, elems]``: row ``j`` holds chunk ``j`` of the summed
+    payload (elems/n each)."""
+    if x.ndim != 2:
+        raise ValueError(f"expected a [devices, elems] payload, got {x.shape}")
+    n, elems = x.shape
+    assert elems % n == 0
+    return expected_all_reduce(x)[0].reshape(n, elems // n)
 
 
 def expected_all_to_all(x: np.ndarray, axis_size: int) -> np.ndarray:

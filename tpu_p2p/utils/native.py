@@ -183,7 +183,10 @@ def format_header(title: str, n: int) -> Optional[str]:
     lib = _load()
     if lib is None:
         return None
-    buf = ctypes.create_string_buffer(64 + 7 * n)
+    # Sized from the title (not a fixed constant): the production title
+    # is 55 chars, and a fixed 64-byte slack would silently fall back
+    # to Python formatting the day the title grows.
+    buf = ctypes.create_string_buffer(len(title.encode()) + 16 + 7 * n)
     w = lib.tpu_p2p_format_header(title.encode(), n, buf, len(buf))
     return buf.raw[:w].decode() if w > 0 else None
 

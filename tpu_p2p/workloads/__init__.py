@@ -6,6 +6,7 @@ Importing this package registers every pattern in
 
 from tpu_p2p.workloads.base import WORKLOADS, WorkloadContext, workload  # noqa: F401
 from tpu_p2p.workloads import (  # noqa: F401  (registration side effects)
+    allreduce,
     alltoall,
     flagship_step,
     latency,

@@ -1,0 +1,104 @@
+"""Reduction collectives — ``allreduce`` (psum) and ``reduce_scatter``.
+
+The reference measures only point-to-point transport
+(``/root/reference/p2p_matrix.cc:141-267``); these patterns complete
+the named-workload set with the *reduction* transports of SURVEY.md
+§2.3's DP row and the ZeRO/FSDP path (tpu_p2p/parallel/fsdp.py):
+data-parallel gradients ride allreduce, ZeRO gradients ride
+reduce-scatter (and the matching parameter gathers ride all-gather).
+
+Byte accounting follows the standard ring-algorithm busbw convention
+so the numbers compare directly with NCCL's ``busbw`` column:
+
+- allreduce: one op moves ``2 (n-1)/n * msg`` bytes per device
+  (reduce-scatter phase + all-gather phase);
+- reduce_scatter alone: ``(n-1)/n * msg``.
+
+In ``fused``/``differential`` modes the reduce_scatter chain unit must
+preserve shape to sit in a ``lax.scan``, so each hop is
+psum_scatter + tiled all_gather — i.e. one explicit ring-decomposed
+allreduce — and is accounted as ``2 (n-1)/n * msg``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from tpu_p2p.config import format_size
+from tpu_p2p.parallel import collectives as C
+from tpu_p2p.utils.errors import BackendError
+from tpu_p2p.workloads.base import (
+    WorkloadContext,
+    cell_record,
+    measure_collective,
+    workload,
+)
+
+
+def _verify(fn, x, want: np.ndarray, what: str) -> None:
+    got = np.asarray(fn(x))
+    if not np.array_equal(got, want):
+        raise BackendError(f"payload verification failed for {what}")
+
+
+def _run_reduction(ctx: WorkloadContext, name: str) -> list:
+    rt, cfg = ctx.rt, ctx.cfg
+    mesh, n = rt.mesh, rt.num_devices
+    results = []
+    for msg_bytes in cfg.sizes():
+        x = ctx.payloads.get(mesh, msg_bytes, np.dtype(cfg.dtype))
+        if name == "allreduce":
+            single = ctx.cache.all_reduce(mesh, "d")
+            chain = lambda k: ctx.cache.psum_chain(mesh, "d", k)
+            bpd = 2 * (n - 1) * msg_bytes // n
+            note = "ring busbw 2(n-1)/n"
+        else:
+            if x.shape[-1] % n:
+                raise BackendError(
+                    f"reduce_scatter needs payload elems divisible by "
+                    f"{n} devices; {format_size(msg_bytes)} of {cfg.dtype} "
+                    f"gives {x.shape[-1]}"
+                )
+            single = ctx.cache.reduce_scatter(mesh, "d")
+            chain = lambda k: ctx.cache.rs_ag_chain(mesh, "d", k)
+            # Serialized times the bare RS; chained modes time RS+AG.
+            bpd = ((n - 1) * msg_bytes // n if cfg.mode == "serialized"
+                   else 2 * (n - 1) * msg_bytes // n)
+            note = ("(n-1)/n" if cfg.mode == "serialized"
+                    else "rs+ag chain 2(n-1)/n")
+        gbps_val, samples = measure_collective(
+            ctx, single, chain, x, bytes_per_device=bpd
+        )
+        if cfg.check:
+            want = (C.expected_all_reduce(np.asarray(x))
+                    if name == "allreduce"
+                    else C.expected_reduce_scatter(np.asarray(x)))
+            _verify(single, x, want, f"{name} at {msg_bytes}B")
+        if ctx.is_printer:
+            sys.stdout.write(
+                f"{name} {format_size(msg_bytes)} {cfg.mode}: "
+                f"{gbps_val:6.02f} Gbps/device busbw  "
+                f"(p50 {samples.p50 * 1e6:.1f}us, {n} devices, {note})\n"
+            )
+            sys.stdout.flush()
+        ctx.record(
+            cell_record(
+                ctx, workload=name, direction="uni", src=0, dst=0,
+                msg_bytes=msg_bytes, gbps_val=gbps_val, samples=samples,
+                devices=n, accounting=note,
+            )
+        )
+        results.append({"msg_bytes": msg_bytes, "gbps_per_device": gbps_val})
+    return results
+
+
+@workload("allreduce")
+def run_allreduce(ctx: WorkloadContext) -> list:
+    return _run_reduction(ctx, "allreduce")
+
+
+@workload("reduce_scatter")
+def run_reduce_scatter(ctx: WorkloadContext) -> list:
+    return _run_reduction(ctx, "reduce_scatter")

@@ -96,30 +96,54 @@ def measure_edges(
     where each device moves a different byte count than ``msg_bytes``
     (e.g. all_to_all moves ``msg*(n-1)/n``).
     """
+    x = ctx.payloads.get(mesh, msg_bytes, np.dtype(ctx.cfg.dtype))
+    nbytes = bytes_per_device if bytes_per_device is not None else msg_bytes
+    return measure_collective(
+        ctx,
+        ctx.cache.permute(mesh, axis, edges),
+        lambda k: ctx.cache.permute_chain(mesh, axis, edges, k),
+        x,
+        bytes_per_device=nbytes,
+        directions=directions,
+    )
+
+
+def measure_collective(
+    ctx: WorkloadContext,
+    single_fn,
+    chain_builder,
+    x,
+    *,
+    bytes_per_device: int,
+    directions: int = 1,
+) -> tuple:
+    """Mode dispatch for non-permute collectives → (gbps, Samples).
+
+    ``single_fn``: one compiled op (the serialized / one-in-flight
+    unit); ``chain_builder(k)``: a compiled k-op data-dependent chain
+    (the fused / differential unit). Byte accounting is the caller's:
+    ``bytes_per_device`` is what one op moves per device (e.g. the ring
+    allreduce convention ``2(n-1)/n * msg``).
+    """
     cfg = ctx.cfg
-    dtype = np.dtype(cfg.dtype)
-    x = ctx.payloads.get(mesh, msg_bytes, dtype)
     barrier = ctx.rt.barrier
     if cfg.mode == "serialized":
-        fn = ctx.cache.permute(mesh, axis, edges)
         s = timing.measure_serialized(
-            fn, x, cfg.iters, warmup=cfg.warmup, timeout_s=cfg.timeout_s,
-            barrier=barrier,
+            single_fn, x, cfg.iters, warmup=cfg.warmup,
+            timeout_s=cfg.timeout_s, barrier=barrier,
         )
     elif cfg.mode == "fused":
-        chain = ctx.cache.permute_chain(mesh, axis, edges, cfg.iters)
         s = timing.measure_fused(
-            chain, x, cfg.iters, repeats=cfg.fused_repeats, warmup=cfg.warmup,
-            timeout_s=cfg.timeout_s, barrier=barrier,
+            chain_builder(cfg.iters), x, cfg.iters, repeats=cfg.fused_repeats,
+            warmup=cfg.warmup, timeout_s=cfg.timeout_s, barrier=barrier,
         )
-    else:  # differential — per-hop slope between two chain lengths
+    else:  # differential
         s = timing.measure_differential(
-            lambda k: ctx.cache.permute_chain(mesh, axis, edges, k),
-            x, cfg.iters, repeats=cfg.fused_repeats,
+            chain_builder, x, cfg.iters, repeats=cfg.fused_repeats,
             timeout_s=cfg.timeout_s, barrier=barrier,
         )
-    nbytes = bytes_per_device if bytes_per_device is not None else msg_bytes
-    return timing.gbps(nbytes, s.mean_region, directions=directions), s
+    return timing.gbps(bytes_per_device, s.mean_region,
+                       directions=directions), s
 
 
 def verify_edges(ctx: WorkloadContext, mesh, axis: str, edges, msg_bytes: int) -> None:
