@@ -77,6 +77,11 @@ class FlagshipConfig:
     # optimizer moments) sharded over dp, all-gathered on use inside
     # the step; autodiff turns the gather's transpose into the ZeRO
     # gradient reduce-scatter. See tpu_p2p/parallel/fsdp.py.
+    use_flash: bool = False  # Pallas flash kernel for the attention
+    # math. Trainable with sp_strategy="ulysses" (local attention sees
+    # the full sequence, so the custom-vjp kernel drops in) and with
+    # sp size 1; the ring path's streaming-carry kernel is
+    # forward-only, so ring + use_flash raises.
 
     @property
     def model_dim(self) -> int:
@@ -212,12 +217,23 @@ def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
     q = jnp.einsum("btm,hmd->bhtd", x, sub_params["wq"])
     k = jnp.einsum("btm,hmd->bhtd", x, sub_params["wk"])
     v = jnp.einsum("btm,hmd->bhtd", x, sub_params["wv"])
+    sp_size = jax.lax.axis_size(sp) if sp is not None else 1
     if sp is not None and cfg.sp_strategy == "ulysses":
         from tpu_p2p.ops.ulysses import ulysses_attention_local
 
-        a = ulysses_attention_local(q, k, v, sp, causal=cfg.causal)
-    elif sp is not None:
+        a = ulysses_attention_local(q, k, v, sp, causal=cfg.causal,
+                                    use_flash=cfg.use_flash)
+    elif sp is not None and sp_size > 1:
+        if cfg.use_flash:
+            raise ValueError(
+                "use_flash requires sp_strategy='ulysses' (or sp size 1): "
+                "the ring path's streaming flash kernel is forward-only"
+            )
         a = ring_attention_local(q, k, v, sp, causal=cfg.causal)
+    elif cfg.use_flash:  # size-1 sp (or no sp axis): sequence is local
+        from tpu_p2p.ops.flash_attention import flash_attention
+
+        a = flash_attention(q, k, v, cfg.causal)
     else:
         a = dense_attention(q, k, v, causal=cfg.causal)
     y = jnp.einsum("bhtd,hdm->btm", a, sub_params["wo"])

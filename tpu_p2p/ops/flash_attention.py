@@ -142,6 +142,18 @@ def _kernel(offs_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
         l_ref[0] = l * alpha + p.sum(axis=-1, keepdims=True)
 
 
+def _union_vma(*arrays):
+    """(union varying-mesh-axes set, arrays each pcast up to it)."""
+    vma = _vma_of(*arrays)
+    out = []
+    for a in arrays:
+        missing = vma - getattr(jax.typeof(a), "vma", frozenset())
+        out.append(
+            jax.lax.pcast(a, tuple(missing), to="varying") if missing else a
+        )
+    return vma, out
+
+
 def _gqa_group(bh_q: int, bh_kv: int, q_heads: int) -> int:
     """Derive and validate the GQA group size from flattened row counts
     (``B·H_q``, ``B·H_kv``) and the per-batch query head count. Raises
@@ -170,6 +182,68 @@ def _kv_row_map(q_heads: int, group: int):
     return lambda i: (i // q_heads) * h_kv + (i % q_heads) // group
 
 
+def _expand_kv_rows(k3, bh: int, q_heads: int):
+    """GQA: widen a ``[B·H_kv, T, D]`` tensor to ``[B·H_q, T, D]``
+    (the jax-path analogue of the kernel's narrow-row BlockSpec map) —
+    delegates to :func:`tpu_p2p.ops.attention.repeat_kv`, the one GQA
+    head-widening convention."""
+    from tpu_p2p.ops.attention import repeat_kv
+
+    b = bh // q_heads
+    tk, d = k3.shape[1], k3.shape[2]
+    wide = repeat_kv(k3.reshape(b, -1, tk, d), q_heads)
+    return wide.reshape(bh, tk, d)
+
+
+def _causal_mask(tq, tk, q_off, k_off):
+    q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+    k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+    return q_pos >= k_pos
+
+
+def _flash_call_jax(q3, k3, v3, o0, m0, l0, q_off, k_off, *,
+                    causal: bool, q_heads: int):
+    """Plain-jax accumulate pass with the kernel's exact math — used
+    when ``interpret`` is on *and* operands carry varying-mesh-axes
+    typing: pallas's HLO interpreter evaluates the kernel jaxpr inline,
+    where its mixed-vma dynamic_slices trip shard_map's checker (the
+    ring path sidesteps this with check_vma=False; the flagship's
+    shard_map keeps checking on, so its CPU tests land here). On real
+    TPU the compiled kernel is a single primitive and never hits this.
+    """
+    bh, tq, d = q3.shape
+    scale = 1.0 / (d ** 0.5)
+    k3 = _expand_kv_rows(k3, bh, q_heads)
+    v3 = _expand_kv_rows(v3, bh, q_heads)
+    s = jax.lax.dot_general(
+        q3, k3, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale                            # (bh, tq, tk)
+    if causal:
+        visible = _causal_mask(tq, k3.shape[1], q_off, k_off)
+        s = jnp.where(visible, s, NEG_INF)
+    m_new = jnp.maximum(m0, s.max(axis=-1))
+    alpha = jnp.exp(m0 - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    if causal:
+        p = jnp.where(visible, p, 0.0)
+    pv = jax.lax.dot_general(
+        p.astype(v3.dtype), v3, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    return (
+        o0 * alpha[..., None] + pv,
+        m_new,
+        l0 * alpha + p.sum(axis=-1),
+    )
+
+
+def _vma_of(*arrays) -> frozenset:
+    return frozenset().union(
+        *(getattr(jax.typeof(a), "vma", frozenset()) for a in arrays)
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("causal", "block_q", "block_k", "q_heads", "interpret"),
@@ -185,6 +259,9 @@ def _flash_call(q3, k3, v3, o0, m0, l0, q_off, k_off, *,
     ``q_heads`` = per-batch query head count, from which the GQA group
     size is derived (``H_q == H_kv`` → plain MHA).
     """
+    if interpret and _vma_of(q3, k3, v3, o0, m0, l0):
+        return _flash_call_jax(q3, k3, v3, o0, m0, l0, q_off, k_off,
+                               causal=causal, q_heads=q_heads)
     bh, tq, d = q3.shape
     tk = k3.shape[1]
     group = _gqa_group(bh, k3.shape[0], q_heads)
@@ -221,10 +298,12 @@ def _flash_call(q3, k3, v3, o0, m0, l0, q_off, k_off, *,
     )
     # Inside shard_map, outputs must carry varying-mesh-axes typing:
     # they vary over every axis any input varies over (e.g. "sp" when
-    # called from ring attention).
-    vma = frozenset().union(
-        *(getattr(jax.typeof(a), "vma", frozenset())
-          for a in (q3, k3, v3, o0, m0, l0))
+    # called from ring attention) — and every *operand* must carry the
+    # full union, or pallas rejects the mixed-typing dynamic_slice:
+    # Ulysses/standalone calls pass constant offsets and fresh zero
+    # carries (unvarying) next to sp-varying tensors.
+    vma, (offs, q3, k3, v3, o0, m0, l0) = _union_vma(
+        offs, q3, k3, v3, o0, m0, l0
     )
     kernel = functools.partial(
         _kernel, block_k=block_k, causal=causal, scale=scale,
@@ -408,6 +487,44 @@ def _bwd_dq_kernel(offs_ref, k_ref, v_ref, do_ref, L_ref, dl_ref, q_ref,
         )
 
 
+def _flash_bwd_jax(q3, k3, v3, do3, L, delta, q_off, k_off, *,
+                   causal: bool, q_heads: int):
+    """Plain-jax FlashAttention-2 backward (see :func:`_flash_call_jax`
+    for when this path runs). Matches the kernels' contract: dk/dv come
+    back per *query* head (``B·H_q`` rows); the caller folds GQA groups.
+    """
+    bh, tq, d = q3.shape
+    scale = 1.0 / (d ** 0.5)
+    ke = _expand_kv_rows(k3, bh, q_heads)
+    ve = _expand_kv_rows(v3, bh, q_heads)
+    s = jax.lax.dot_general(
+        q3, ke, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        s = jnp.where(_causal_mask(tq, ke.shape[1], q_off, k_off), s, NEG_INF)
+    p = jnp.exp(s - L[..., None])  # fully-masked rows: L == +1e30 → 0
+    dp = jax.lax.dot_general(
+        do3.astype(jnp.float32), ve.astype(jnp.float32),
+        (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jax.lax.dot_general(
+        ds, ke.astype(jnp.float32), (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    dk = jax.lax.dot_general(
+        ds, q3.astype(jnp.float32), (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    dv = jax.lax.dot_general(
+        p, do3.astype(jnp.float32), (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    return dq, dk, dv
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("causal", "block_q", "block_k", "q_heads", "interpret"),
@@ -424,6 +541,9 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
     keeping the kernel's output-revisiting pattern identical to MHA at
     the cost of a factor-``group`` f32 write the XLA-level sum folds.
     """
+    if interpret and _vma_of(q3, k3, v3, do3, L, delta):
+        return _flash_bwd_jax(q3, k3, v3, do3, L, delta, q_off, k_off,
+                               causal=causal, q_heads=q_heads)
     bh, tq, d = q3.shape
     tk = k3.shape[1]
     group = _gqa_group(bh, k3.shape[0], q_heads)
@@ -432,9 +552,9 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
     offs = jnp.array([q_off, k_off], jnp.int32).reshape(2)
     L = L.reshape(bh, tq, 1)
     delta = delta.reshape(bh, tq, 1)
-    vma = frozenset().union(
-        *(getattr(jax.typeof(a), "vma", frozenset())
-          for a in (q3, k3, v3, do3, L, delta))
+    # See _flash_call: every operand must carry the union vma.
+    vma, (offs, q3, k3, v3, do3, L, delta) = _union_vma(
+        offs, q3, k3, v3, do3, L, delta
     )
 
     # Both kernels share block shapes but differ in which middle grid

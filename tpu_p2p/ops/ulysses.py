@@ -51,13 +51,22 @@ def _seq_to_heads(x, axis_name: str):
                               tiled=True)
 
 
-def ulysses_attention_local(q, k, v, axis_name: str, *, causal: bool = False):
+def ulysses_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
+                            use_flash: bool = False):
     """Per-shard Ulysses attention body — call inside ``shard_map``.
 
     ``q, k, v``: local blocks ``[B, H, T_local, D]``, sequence sharded
     along ``axis_name``; requires ``H`` divisible by the axis size.
-    Four ``all_to_all`` reshards (three in, one out) bracket one dense
-    local attention over the full sequence.
+    Four ``all_to_all`` reshards (three in, one out) bracket one local
+    attention over the full sequence.
+
+    ``use_flash`` runs that local attention in the Pallas flash kernel
+    (:func:`tpu_p2p.ops.flash_attention.flash_attention`) instead of
+    the dense XLA path. Because Ulysses sees the *whole* sequence
+    locally, the fully-differentiable standalone kernel drops straight
+    in — unlike the ring path, whose streaming carry only has a
+    forward-mode kernel — so this is the trainable flash+SP
+    composition (the flagship's ``use_flash`` rides it).
     """
     n = jax.lax.axis_size(axis_name)
     h, h_kv = q.shape[1], k.shape[1]
@@ -73,7 +82,12 @@ def ulysses_attention_local(q, k, v, axis_name: str, *, causal: bool = False):
     vh = _heads_to_seq(v, axis_name)
     # Full sequence is local now, so the plain causal mask is correct —
     # no global-position bookkeeping as in the ring's block masking.
-    ah = dense_attention(qh, kh, vh, causal=causal)
+    if use_flash:
+        from tpu_p2p.ops.flash_attention import flash_attention
+
+        ah = flash_attention(qh, kh, vh, causal)
+    else:
+        ah = dense_attention(qh, kh, vh, causal=causal)
     return _seq_to_heads(ah, axis_name)
 
 
