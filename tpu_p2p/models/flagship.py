@@ -441,13 +441,20 @@ def place_flagship_params(params: Params, mesh: Mesh,
             for k, v in params.items()}
 
 
+def flagship_host_batch(cfg: FlagshipConfig, rng) -> Tuple:
+    """One host-side ``(x, target)`` batch — the single source of the
+    flagship batch shape/dtype, shared by :func:`flagship_example_batch`
+    and :func:`tpu_p2p.utils.data.flagship_loader`."""
+    shape = (cfg.batch, cfg.seq, cfg.model_dim)
+    dtype = jnp.dtype(cfg.dtype)
+    return (rng.standard_normal(shape).astype(dtype),
+            rng.standard_normal(shape).astype(dtype))
+
+
 def flagship_example_batch(cfg: FlagshipConfig, mesh: Mesh = None,
                            seed: int = 1) -> Tuple:
-    rng = np.random.default_rng(seed)
-    dtype = jnp.dtype(cfg.dtype)
-    shape = (cfg.batch, cfg.seq, cfg.model_dim)
-    x = jnp.asarray(rng.standard_normal(shape), dtype=dtype)
-    t = jnp.asarray(rng.standard_normal(shape), dtype=dtype)
+    x, t = flagship_host_batch(cfg, np.random.default_rng(seed))
+    x, t = jnp.asarray(x), jnp.asarray(t)
     if mesh is not None:
         sharding = NamedSharding(mesh, flagship_data_spec(mesh))
         x, t = jax.device_put(x, sharding), jax.device_put(t, sharding)

@@ -1,0 +1,130 @@
+"""Input pipeline — sharded host→device loading with prefetch.
+
+The reference has no input path at all (its buffers are allocated once
+and zeroed on device, ``/root/reference/p2p_matrix.cc:124-130``); a
+training framework needs one, and on TPU its shape is dictated by two
+facts: ``device_put`` is asynchronous (the transfer is enqueued, not
+awaited), and a step's host→device copies can hide entirely under the
+previous step's compute if they are issued early enough. So the loader
+is just disciplined use of the runtime:
+
+- :class:`DeviceLoader` wraps any iterator of host batches (numpy
+  arrays or pytrees of them) and keeps ``prefetch`` batches in flight
+  on device: each ``next()`` returns an already-transferring batch and
+  tops the queue back up, so the copy for step ``i+k`` overlaps the
+  compute of step ``i``. No threads — async dispatch is the engine.
+- Sharding is first-class: every batch lands distributed per a
+  ``PartitionSpec`` over the mesh. Under multi-host each process feeds
+  only its *local* shard and the loader assembles the global
+  ``jax.Array`` (``make_array_from_process_local_data``), so no host
+  ever materializes the global batch.
+- :func:`synthetic_batches` supplies the benchmark/test source: seeded
+  random batches shaped for the flagship model.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+Batch = Any  # a numpy array or an arbitrary pytree of them
+
+
+class DeviceLoader:
+    """Iterate device-resident, mesh-sharded batches with prefetch.
+
+    ``source`` yields host batches (pytrees of numpy arrays) whose
+    leading dims match ``spec`` — the *global* batch on single-host,
+    this process's row-block of it under multi-host.
+    """
+
+    def __init__(self, source: Iterable[Batch], mesh: Mesh,
+                 spec: PartitionSpec, prefetch: int = 2) -> None:
+        if prefetch < 1:
+            raise ValueError(f"prefetch must be >= 1, got {prefetch}")
+        self._it = iter(source)
+        self._mesh = mesh
+        self._sharding = NamedSharding(mesh, spec)
+        self._prefetch = prefetch
+        self._queue: deque = deque()
+        self._exhausted = False
+        self._error: Optional[BaseException] = None
+
+    def _put(self, host_batch: Batch):
+        if jax.process_count() > 1:
+            return jax.tree.map(
+                lambda a: jax.make_array_from_process_local_data(
+                    self._sharding, np.asarray(a)
+                ),
+                host_batch,
+            )
+        return jax.device_put(host_batch, self._sharding)
+
+    def _fill(self) -> None:
+        while (not self._exhausted and self._error is None
+               and len(self._queue) < self._prefetch):
+            try:
+                self._queue.append(self._put(next(self._it)))
+            except StopIteration:
+                self._exhausted = True
+            except BaseException as e:  # noqa: BLE001 — deferred below
+                # Don't let a source error during top-up swallow batches
+                # already in flight: park it and surface it only once
+                # the queue has drained.
+                self._error = e
+
+    def __iter__(self) -> Iterator[Batch]:
+        return self
+
+    def __next__(self) -> Batch:
+        self._fill()
+        if self._queue:
+            batch = self._queue.popleft()
+            self._fill()  # keep the pipe full before handing control back
+            return batch
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise e
+        raise StopIteration
+
+    @property
+    def in_flight(self) -> int:
+        """Batches currently enqueued on device (tests/introspection)."""
+        return len(self._queue)
+
+
+def synthetic_batches(shape, *, count: Optional[int] = None, seed: int = 0,
+                      dtype=np.float32,
+                      make: Optional[Callable[[np.random.Generator], Batch]] = None
+                      ) -> Iterator[Batch]:
+    """Seeded random host batches — the framework's benchmark source.
+
+    Yields ``count`` batches (infinite when None) of ``shape``; pass
+    ``make`` to build arbitrary pytree batches from the generator
+    (e.g. ``lambda r: {"x": ..., "y": ...}``).
+    """
+    rng = np.random.default_rng(seed)
+    i = 0
+    while count is None or i < count:
+        if make is not None:
+            yield make(rng)
+        else:
+            yield rng.standard_normal(shape).astype(dtype)
+        i += 1
+
+
+def flagship_loader(cfg, mesh: Mesh, *, count: Optional[int] = None,
+                    seed: int = 0, prefetch: int = 2) -> DeviceLoader:
+    """A ready-to-train loader of ``(x, target)`` flagship batches,
+    sharded like :func:`tpu_p2p.models.flagship.flagship_data_spec`."""
+    from tpu_p2p.models.flagship import flagship_data_spec, flagship_host_batch
+
+    return DeviceLoader(
+        synthetic_batches(None, count=count, seed=seed,
+                          make=lambda rng: flagship_host_batch(cfg, rng)),
+        mesh, flagship_data_spec(mesh), prefetch=prefetch,
+    )
