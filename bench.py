@@ -64,6 +64,45 @@ def _flash_tflops(timing):
     return round(flops / s.mean_region / 1e12, 1)
 
 
+def _flagship_step_metrics():
+    """Loader-fed flagship train-step throughput (tokens/s) at a
+    bf16 single-chip config — the end-to-end model-level number
+    complementing the kernel/HBM microbenchmarks. Timed by wall clock
+    over N steps with a final scalar readback, which forces completion
+    regardless of the relay's block-fence behavior."""
+    import time
+
+    import jax
+
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.utils.data import flagship_loader
+
+    mesh = F.build_mesh(1, devices=jax.devices()[:1])
+    cfg = F.FlagshipConfig(
+        batch=4, seq=1024, heads=8, head_dim=64, stages=2, microbatches=2,
+        num_experts=4, dtype="bfloat16",
+    )
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    step = F.make_flagship_train_step(mesh, cfg, lr=1e-2)
+    for x, t in flagship_loader(cfg, mesh, count=1):
+        params, loss = step(params, x, t)  # compile + warm
+    float(loss)
+    n = 8
+    t0 = time.perf_counter()
+    for x, t in flagship_loader(cfg, mesh, count=n, seed=1):
+        params, loss = step(params, x, t)
+    final = float(loss)  # readback fences the whole pipeline
+    dt = (time.perf_counter() - t0) / n
+    import math
+
+    if not math.isfinite(final):
+        raise RuntimeError(f"non-finite flagship loss {final}")
+    return {
+        "flagship_step_ms": round(dt * 1e3, 1),
+        "flagship_tokens_per_s": round(cfg.batch * cfg.seq / dt),
+    }
+
+
 def main() -> int:
     import numpy as np
 
@@ -134,6 +173,13 @@ def main() -> int:
             # benchmark fails (OOM, compile error, odd backend).
             print(f"# flash tflops measurement failed: {e!r}", file=sys.stderr)
             flash_tflops = None
+        try:
+            flagship = _flagship_step_metrics()
+        except Exception as e:  # noqa: BLE001 — same rationale
+            print(f"# flagship step measurement failed: {e!r}", file=sys.stderr)
+            # Explicit nulls keep the JSON schema stable across runs.
+            flagship = {"flagship_step_ms": None,
+                        "flagship_tokens_per_s": None}
         result = {
             "metric": "loopback_hbm_rewrite_bandwidth",
             "value": round(float(value), 3),
@@ -150,6 +196,7 @@ def main() -> int:
                 ),
                 "per_op_floor_us": round(s8.mean_region * 1e6, 2),
                 "flash_attention_tflops": flash_tflops,
+                **flagship,
                 "mode": "differential",
                 "block_fence_trustworthy": fence_ok,
             },

@@ -52,7 +52,7 @@ class DeviceLoader:
         self._prefetch = prefetch
         self._queue: deque = deque()
         self._exhausted = False
-        self._error: Optional[BaseException] = None
+        self._error: Optional[Exception] = None
 
     def _put(self, host_batch: Batch):
         if jax.process_count() > 1:
@@ -71,10 +71,11 @@ class DeviceLoader:
                 self._queue.append(self._put(next(self._it)))
             except StopIteration:
                 self._exhausted = True
-            except BaseException as e:  # noqa: BLE001 — deferred below
+            except Exception as e:  # noqa: BLE001 — deferred below.
                 # Don't let a source error during top-up swallow batches
                 # already in flight: park it and surface it only once
-                # the queue has drained.
+                # the queue has drained. Exception, not BaseException:
+                # KeyboardInterrupt/SystemExit must propagate now.
                 self._error = e
 
     def __iter__(self) -> Iterator[Batch]:
