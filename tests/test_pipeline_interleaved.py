@@ -1,0 +1,158 @@
+"""Interleaved (virtual-stage) 1F1B: schedule soundness, bubble
+reduction vs plain 1F1B, and gradient parity with GPipe + the oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpu_p2p.models import pipeline as PL
+from tpu_p2p.models import pipeline_1f1b as FB
+from tpu_p2p.models import pipeline_interleaved as IL
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("pp",))
+
+
+def _setup(stages, m, b=8, t=4, d=8, f=16, seed=0):
+    cfg = PL.PipelineConfig(d_model=d, d_ff=f, stages=stages, microbatches=m)
+    params = PL.init_pipeline_params(cfg, seed=seed)
+    rng = np.random.default_rng(seed + 1)
+    x = jnp.asarray(rng.standard_normal((b, t, d)), jnp.float32)
+    tgt = jnp.asarray(rng.standard_normal((b, t, d)), jnp.float32)
+    return cfg, params, x, tgt
+
+
+# ---------------------------------------------------------------- schedule
+
+
+@pytest.mark.parametrize("m,n,v", [(1, 1, 1), (4, 2, 2), (8, 2, 2),
+                                   (8, 4, 2), (4, 2, 3), (6, 3, 2),
+                                   (2, 4, 2), (8, 1, 4)])
+def test_interleaved_schedule_sound(m, n, v):
+    s = IL.build_interleaved_schedule(m, n, v)
+    s_virt = n * v
+    fwd = np.full((s_virt, m), -1)
+    bwd = np.full((s_virt, m), -1)
+    for t in range(s.num_ticks):
+        for d in range(n):
+            if (mb := s.f_mb[t, d]) >= 0:
+                sv = d + s.f_cidx[t, d] * n
+                assert fwd[sv, mb] == -1
+                fwd[sv, mb] = t
+            if (mb := s.b_mb[t, d]) >= 0:
+                sv = d + s.b_cidx[t, d] * n
+                assert bwd[sv, mb] == -1
+                bwd[sv, mb] = t
+    assert (fwd >= 0).all() and (bwd >= 0).all()
+    for sv in range(s_virt):
+        for mb in range(m):
+            if sv > 0:
+                assert fwd[sv, mb] > fwd[sv - 1, mb]  # +1 wire latency
+            if sv < s_virt - 1:
+                assert bwd[sv, mb] > bwd[sv + 1, mb]
+            assert bwd[sv, mb] > fwd[sv, mb]
+
+
+@pytest.mark.parametrize("m,n,v", [(8, 2, 2), (8, 4, 2), (6, 3, 2)])
+def test_interleaved_stash_replay_conflict_free(m, n, v):
+    s = IL.build_interleaved_schedule(m, n, v)
+    for d in range(n):
+        owner = [None] * s.act_slots
+        gown = [None] * s.grad_slots
+        for t in range(s.num_ticks):
+            if (rs := s.recv_slot[t, d]) >= 0:
+                assert owner[rs] is None, f"act clobber @t{t} d{d}"
+                owner[rs] = "pending"
+            if (gs := s.grecv_slot[t, d]) >= 0:
+                assert gown[gs] is None, f"grad clobber @t{t} d{d}"
+                gown[gs] = "pending"
+            if s.f_mb[t, d] >= 0 and d == 0 and s.f_cidx[t, d] == 0:
+                fs = s.f_slot[t, d]
+                assert owner[fs] is None
+                owner[fs] = "pending"
+            if s.b_mb[t, d] >= 0:
+                bs = s.b_slot[t, d]
+                assert owner[bs] == "pending", f"empty act read @t{t} d{d}"
+                owner[bs] = None
+                sv = d + s.b_cidx[t, d] * n
+                if sv < n * v - 1:
+                    bg = s.b_gslot[t, d]
+                    assert gown[bg] == "pending", f"empty grad read @t{t}"
+                    gown[bg] = None
+
+
+def test_interleaving_shrinks_the_bubble():
+    # Same 8 total stages on 4 devices, measured in stage-units of
+    # compute per device (a blocked-1F1B tick runs v=2 fused stages,
+    # an interleaved tick runs 1): ideal work is 2·m·v units; the
+    # interleaved bubble must be smaller than the blocked bubble.
+    m, n, v = 16, 4, 2
+    ideal = 2 * m * v
+    blocked_units = FB.build_1f1b_schedule(m, n).num_ticks * v
+    inter_units = IL.build_interleaved_schedule(m, n, v).num_ticks
+    assert inter_units - ideal < blocked_units - ideal, (
+        inter_units, blocked_units, ideal
+    )
+    # Pin the alternating policy's result: 70 = ideal 64 + the
+    # 2(n-1)-unit fill/drain bound. A policy change that re-opens the
+    # bubble (e.g. reverting to strict B-first: 79) must fail here.
+    assert inter_units == 70, inter_units
+
+
+# ---------------------------------------------------------------- numerics
+
+
+@pytest.mark.parametrize("n,v,m", [(2, 2, 4), (2, 2, 8), (4, 2, 4),
+                                   (2, 3, 4), (1, 4, 4), (8, 1, 8)])
+def test_interleaved_step_matches_gpipe(n, v, m):
+    stages = n * v
+    cfg, params, x, tgt = _setup(stages, m)
+    gp_mesh = _mesh(stages)
+    p_gp = PL.place_pipeline_params(params, gp_mesh)
+    want, l_gp = PL.make_pipeline_train_step(gp_mesh, cfg, lr=5e-2)(
+        p_gp, x, tgt
+    )
+
+    il_mesh = _mesh(n)
+    p_il = IL.place_interleaved_params(params, il_mesh, v)
+    got_dm, l_il = IL.make_interleaved_train_step(il_mesh, cfg, v, lr=5e-2)(
+        p_il, x, tgt
+    )
+    got = IL.unplace_interleaved_params(got_dm, il_mesh, v)
+    np.testing.assert_allclose(float(l_il), float(l_gp), atol=1e-5, rtol=1e-5)
+    for k in params:
+        np.testing.assert_allclose(got[k], np.asarray(want[k]),
+                                   atol=1e-5, rtol=1e-5, err_msg=k)
+
+
+def test_interleaved_training_decreases_loss():
+    cfg, params, x, tgt = _setup(stages=4, m=4)
+    mesh = _mesh(2)
+    placed = IL.place_interleaved_params(params, mesh, 2)
+    step = IL.make_interleaved_train_step(mesh, cfg, 2, lr=5e-2)
+    losses = []
+    for _ in range(5):
+        placed, loss = step(placed, x, tgt)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_interleaved_rejects_bad_chunking():
+    cfg, params, x, tgt = _setup(stages=4, m=4)
+    with pytest.raises(ValueError, match="chunks"):
+        IL.make_interleaved_train_step(_mesh(2), cfg, 3)
+    with pytest.raises(ValueError, match="'pp' axis"):
+        IL.make_interleaved_train_step(
+            Mesh(np.array(jax.devices()[:2]), ("d",)), cfg, 2
+        )
+
+
+def test_device_major_roundtrip():
+    a = np.arange(24).reshape(12, 2)
+    dm = IL.to_device_major(a, 3, 4)
+    np.testing.assert_array_equal(IL.from_device_major(dm, 3, 4), a)
+    # Row d*v + c must hold virtual stage d + c*n.
+    np.testing.assert_array_equal(dm[1 * 4 + 2], a[1 + 2 * 3])
