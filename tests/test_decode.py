@@ -1,0 +1,106 @@
+"""KV-cached incremental decoding: teacher-forced step-by-step decode
+must equal the causal training forward position-for-position (exact
+under no-drop MoE capacity), across tp/ep/dp shardings and ZeRO
+storage; plus autoregressive generate and mesh validation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpu_p2p.models import decode as D
+from tpu_p2p.models import flagship as F
+
+
+def _mesh(dp=1, sp=1, tp=1, ep=1, pp=1):
+    n = dp * pp * sp * tp * ep
+    return Mesh(
+        np.array(jax.devices()[:n]).reshape(dp, pp, sp, tp, ep), F.AXES
+    )
+
+
+def _cfg(**kw):
+    # capacity_factor = num_experts → no token ever drops, which is
+    # what makes incremental MoE routing exactly equal joint routing.
+    base = dict(batch=8, seq=8, heads=4, head_dim=8, stages=2,
+                microbatches=2, num_experts=2, capacity_factor=2.0)
+    base.update(kw)
+    return F.FlagshipConfig(**base)
+
+
+@pytest.mark.parametrize("mesh_kw", [dict(), dict(tp=2, ep=2, dp=2),
+                                     dict(dp=4, tp=2)],
+                         ids=["single", "dp2tp2ep2", "dp4tp2"])
+def test_teacher_forced_decode_matches_causal_forward(mesh_kw):
+    mesh = _mesh(**mesh_kw)
+    cfg = _cfg()
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    x_full, _ = F.flagship_example_batch(cfg, mesh)
+    want = np.asarray(F.make_flagship_forward(mesh, cfg)(params, x_full))
+
+    step = D.make_flagship_decode_step(mesh, cfg)
+    cache = D.init_kv_cache(cfg, max_len=cfg.seq, mesh=mesh)
+    for t in range(cfg.seq):
+        cache, y_t = step(params, cache, x_full[:, t:t + 1, :], t)
+        np.testing.assert_allclose(
+            np.asarray(y_t)[:, 0, :], want[:, t, :],
+            atol=1e-4, rtol=1e-4, err_msg=f"position {t}",
+        )
+
+
+def test_decode_with_gqa_cache():
+    mesh = _mesh(tp=2)
+    cfg = _cfg(heads=8, kv_heads=2, microbatches=1)
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    x_full, _ = F.flagship_example_batch(cfg, mesh)
+    want = np.asarray(F.make_flagship_forward(mesh, cfg)(params, x_full))
+    step = D.make_flagship_decode_step(mesh, cfg)
+    cache = D.init_kv_cache(cfg, max_len=cfg.seq, mesh=mesh)
+    assert cache["k"].shape[2] == 2  # narrow GQA cache
+    for t in range(cfg.seq):
+        cache, y_t = step(params, cache, x_full[:, t:t + 1, :], t)
+        np.testing.assert_allclose(np.asarray(y_t)[:, 0, :], want[:, t, :],
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_decode_with_zero_dp_storage():
+    mesh = _mesh(dp=4)
+    cfg = _cfg(zero_dp=True)
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh, cfg)
+    x_full, _ = F.flagship_example_batch(cfg, mesh)
+    want = np.asarray(F.make_flagship_forward(mesh, cfg)(params, x_full))
+    step = D.make_flagship_decode_step(mesh, cfg)
+    cache = D.init_kv_cache(cfg, max_len=cfg.seq, mesh=mesh)
+    for t in range(cfg.seq):
+        cache, y_t = step(params, cache, x_full[:, t:t + 1, :], t)
+        np.testing.assert_allclose(np.asarray(y_t)[:, 0, :], want[:, t, :],
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_generate_rolls_forward():
+    mesh = _mesh(tp=2, ep=2, dp=2)
+    cfg = _cfg()
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    step = D.make_flagship_decode_step(mesh, cfg)
+    cache = D.init_kv_cache(cfg, max_len=16, mesh=mesh)
+    x0, _ = F.flagship_example_batch(cfg, mesh)
+    x0 = x0[:, :1, :]
+    cache, ys = D.generate(step, params, cache, x0, num_tokens=6)
+    assert ys.shape == (6, cfg.batch, 1, cfg.model_dim)
+    assert np.isfinite(np.asarray(ys)).all()
+    # Rollout must match manual step-by-step feeding.
+    cache2 = D.init_kv_cache(cfg, max_len=16, mesh=mesh)
+    x = x0
+    for i in range(6):
+        cache2, x = step(params, cache2, x, i)
+        np.testing.assert_allclose(np.asarray(x), np.asarray(ys[i]),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_decode_rejects_sp_or_pp_mesh():
+    cfg = _cfg()
+    with pytest.raises(ValueError, match="sp axis size 1"):
+        D.make_flagship_decode_step(_mesh(sp=2), cfg)
+    with pytest.raises(ValueError, match="pp axis size 1"):
+        D.init_kv_cache(cfg, 8, _mesh(pp=2))

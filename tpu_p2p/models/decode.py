@@ -1,0 +1,217 @@
+"""Incremental decoding — KV-cached single-token steps + generate loop.
+
+The inference half of the model layer (the reference is a transport
+benchmark with no model code at all; this completes the framework's
+train/infer story). TPU-first mechanics:
+
+- **Static-shape KV cache.** ``[stages, B, H_kv, max_len, Dh]`` per
+  projection, written in place with ``dynamic_update_slice`` at the
+  (traced) position — no growing shapes, one compiled step reused for
+  every token. GQA caches stay narrow (``H_kv`` heads) and widen only
+  inside the attention contraction.
+- **Masked full-window attention.** Each step attends over the whole
+  ``max_len`` window with positions ``> pos`` masked to −inf: a dense
+  ``[B, H, 1, max_len]`` contraction the MXU eats, instead of a
+  dynamic-length slice XLA cannot tile.
+- **Same shardings as training.** Heads shard over ``tp`` (psum joins
+  the output projection), batch over ``dp``/``ep``, MoE dispatch rides
+  the ``ep`` ``all_to_all``; ZeRO-stored params are gathered on use
+  exactly as in the train step. Decoding is token-recurrent, so the
+  ``sp`` and ``pp`` axes must be size 1 (sequence parallelism and
+  pipelining have no payoff at sequence length 1).
+- **Teacher-forced exactness.** Step-by-step decode of a sequence
+  equals the causal training forward position-for-position (pinned in
+  tests/test_decode.py; for MoE layers this requires no-drop capacity,
+  since capacity dropping depends on the routed token population).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_p2p.models.flagship import (
+    AXES,
+    FlagshipConfig,
+    _axis,
+    _fsdp_plan,
+    _mesh_axes,
+    flagship_param_specs,
+)
+from tpu_p2p.models.moe import moe_layer_local
+from tpu_p2p.ops.attention import NEG_INF, repeat_kv
+
+Cache = Dict[str, jax.Array]
+
+
+def _check_decode_mesh(mesh: Mesh, cfg: FlagshipConfig) -> None:
+    for ax in ("sp", "pp"):
+        if ax in mesh.axis_names and mesh.shape[ax] != 1:
+            raise ValueError(
+                f"decoding needs {ax} axis size 1 (token-recurrent steps "
+                f"can't use sequence/pipeline parallelism); got "
+                f"{mesh.shape[ax]}"
+            )
+    tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
+    for name, count in (("heads", cfg.heads),
+                        ("kv_heads", cfg.num_kv_heads)):
+        if count % tp:
+            raise ValueError(
+                f"{name} ({count}) must divide by the tp axis size ({tp})"
+            )
+
+
+def cache_spec(mesh: Mesh) -> P:
+    """``[stages, B, H_kv, max_len, Dh]``: batch over dp/ep, KV heads
+    over tp."""
+    dp, ep, tp = _axis(mesh, "dp"), _axis(mesh, "ep"), _axis(mesh, "tp")
+    batch_axes = tuple(a for a in (dp, ep) if a is not None)
+    return P(None, batch_axes if batch_axes else None, tp, None, None)
+
+
+def init_kv_cache(cfg: FlagshipConfig, max_len: int, mesh: Mesh) -> Cache:
+    """Zeroed device-resident cache for ``cfg.batch`` sequences."""
+    _check_decode_mesh(mesh, cfg)
+    shape = (cfg.stages, cfg.batch, cfg.num_kv_heads, max_len, cfg.head_dim)
+    sharding = NamedSharding(mesh, cache_spec(mesh))
+
+    def zeros():
+        # Fresh buffer per tensor: device_put-ing ONE zeros array twice
+        # aliases a single buffer, which the decode step's cache
+        # donation would then donate twice (a runtime error).
+        return jax.device_put(jnp.zeros(shape, jnp.dtype(cfg.dtype)),
+                              sharding)
+
+    return {"k": zeros(), "v": zeros()}
+
+
+def _decode_sub_block(sub, x, k_cache, v_cache, pos, cfg, tp, ep):
+    """One transformer block on a single token, against the cache.
+
+    ``x``: ``[B_loc, 1, Dm]``; ``k_cache``/``v_cache``:
+    ``[B_loc, H_kv_loc, max_len, Dh]`` already holding this step's
+    K/V at ``pos``. Mirrors flagship._stage_sub_block's math.
+    """
+    max_len = k_cache.shape[2]
+    q = jnp.einsum("btm,hmd->bhtd", x, sub["wq"])     # [B, H, 1, Dh]
+    kw = repeat_kv(k_cache, q.shape[1])
+    vw = repeat_kv(v_cache, q.shape[1])
+    s = jnp.einsum("bhtd,bhTd->bhtT", q, kw,
+                   preferred_element_type=jnp.float32)
+    s = s / (cfg.head_dim ** 0.5)
+    live = jnp.arange(max_len) <= pos                 # [max_len]
+    s = jnp.where(live[None, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+    a = jnp.einsum("bhtT,bhTd->bhtd", p, vw,
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    y = jnp.einsum("bhtd,hdm->btm", a, sub["wo"])
+    if tp is not None:
+        y = jax.lax.psum(y, tp)
+    x = x + y
+    moe_params = {"router": sub["router"], "w1": sub["we1"], "w2": sub["we2"]}
+    tokens = x.reshape(-1, x.shape[-1])
+    m_out = moe_layer_local(moe_params, tokens, cfg.moe(), ep_axis=ep)
+    return x + m_out.reshape(x.shape)
+
+
+def make_flagship_decode_step(mesh: Mesh, cfg: FlagshipConfig):
+    """Jitted ``(params, cache, x_t, pos) → (cache, y_t)``.
+
+    ``x_t``: global ``[B, 1, Dm]``; ``pos``: scalar int32 position the
+    token occupies (same for the whole batch). The returned cache holds
+    this step's K/V; ``y_t`` is the stack's output for the token.
+    """
+    from tpu_p2p.parallel import fsdp
+
+    _check_decode_mesh(mesh, cfg)
+    axes = _mesh_axes(mesh)
+    tp, ep = axes.get("tp"), axes.get("ep")
+    plan = _fsdp_plan(mesh, cfg)
+
+    dp_ax, ep_ax = _axis(mesh, "dp"), _axis(mesh, "ep")
+    batch_axes = tuple(a for a in (dp_ax, ep_ax) if a is not None)
+    x_spec = P(batch_axes if batch_axes else None, None, None)
+    c_spec = cache_spec(mesh)
+
+    def step(params, cache, x_t, pos):
+        if plan:
+            params = fsdp.all_gather_params(params, "dp", plan)
+        k_all, v_all = cache["k"], cache["v"]
+        x = x_t
+        for s in range(cfg.stages):
+            sub = {kk: vv[s] for kk, vv in params.items()}
+            # Project and write this token's K/V at pos (time axis 2).
+            k_t = jnp.einsum("btm,hmd->bhtd", x, sub["wk"])
+            v_t = jnp.einsum("btm,hmd->bhtd", x, sub["wv"])
+            k_st = jax.lax.dynamic_update_slice_in_dim(
+                k_all[s], k_t, pos, axis=2
+            )
+            v_st = jax.lax.dynamic_update_slice_in_dim(
+                v_all[s], v_t, pos, axis=2
+            )
+            k_all = jax.lax.dynamic_update_index_in_dim(k_all, k_st, s, 0)
+            v_all = jax.lax.dynamic_update_index_in_dim(v_all, v_st, s, 0)
+            x = _decode_sub_block(sub, x, k_st, v_st, pos, cfg, tp, ep)
+        return {"k": k_all, "v": v_all}, x
+
+    # pp is forced to size 1 here, so the stage dim's P('pp') sharding
+    # is byte-identical to replicated — but typed pp-varying it would
+    # poison the outputs' replication inference. Strip it.
+    def strip_pp(spec: P) -> P:
+        return P(*[None if e == "pp" else e for e in tuple(spec)])
+
+    specs = {k: strip_pp(v)
+             for k, v in flagship_param_specs(mesh, cfg).items()}
+    cache_specs = {"k": c_spec, "v": c_spec}
+    sm = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(specs, cache_specs, x_spec, P()),
+        out_specs=(cache_specs, x_spec),
+    )
+    # Donating the cache lets XLA write the token's K/V in place for
+    # direct step-by-step callers (generate's fused scan already does);
+    # callers must treat the passed cache as consumed, as all tests do.
+    return jax.jit(sm, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)  # bounded: each entry pins a compiled
+# rollout + its step closure for the cache's lifetime
+def make_generate(step_fn, num_tokens: int, start_pos: int = 0):
+    """Compiled autoregressive rollout ``(params, cache, x0) →
+    (cache, ys [num_tokens, B, 1, Dm])`` feeding each output back as
+    the next input. Cached per (step, length, start) so repeated calls
+    never re-trace."""
+    @jax.jit
+    def roll(params, cache, x0):
+        # Static window check: dynamic_update_slice clamps the start
+        # index, so decoding past the cache would silently overwrite
+        # the last slot while the mask keeps it live — corrupt output
+        # with no error. Fail at trace time instead.
+        max_len = cache["k"].shape[3]
+        if start_pos + num_tokens > max_len:
+            raise ValueError(
+                f"rollout of {num_tokens} tokens from position "
+                f"{start_pos} overruns the max_len={max_len} cache"
+            )
+
+        def body(carry, i):
+            cache, x = carry
+            cache, y = step_fn(params, cache, x, start_pos + i)
+            return (cache, y), y
+
+        (cache, _), ys = jax.lax.scan(
+            body, (cache, x0), jnp.arange(num_tokens, dtype=jnp.int32)
+        )
+        return cache, ys
+
+    return roll
+
+
+def generate(step_fn, params, cache: Cache, x0, num_tokens: int,
+             start_pos: int = 0) -> Tuple[Cache, jax.Array]:
+    """Convenience wrapper over :func:`make_generate`."""
+    return make_generate(step_fn, num_tokens, start_pos)(params, cache, x0)

@@ -52,9 +52,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpu_p2p.models.pipeline import (
     PipelineConfig,
     _check_pp_mesh,
-    _to_microbatches,
     mlp_block,
-    pp_param_specs,
 )
 
 Params = Dict[str, jax.Array]
@@ -231,139 +229,6 @@ def build_1f1b_schedule(microbatches: int, stages: int) -> Schedule1F1B:
     )
 
 
-def _sched_tables(sched: Schedule1F1B):
-    """Schedule as a pytree of [T, S] int32 — the scan's xs."""
-    return {
-        "f_mb": jnp.asarray(sched.f_mb),
-        "f_slot": jnp.asarray(sched.f_slot),
-        "b_mb": jnp.asarray(sched.b_mb),
-        "b_slot": jnp.asarray(sched.b_slot),
-        "recv_slot": jnp.asarray(sched.recv_slot),
-        "b_gslot": jnp.asarray(sched.b_gslot),
-        "grecv_slot": jnp.asarray(sched.grecv_slot),
-    }
-
-
-def pipeline_1f1b_grads_local(block_fn: Callable, loss_grad_fn: Callable,
-                              params_local: Params, x_mb, target_mb,
-                              sched: Schedule1F1B, axis: str):
-    """Run the 1F1B schedule — call inside ``shard_map`` over ``axis``.
-
-    ``block_fn(params_local, x) -> y`` is the per-stage compute;
-    ``loss_grad_fn(y, target) -> (loss, dL/dy)`` the last stage's
-    per-microbatch loss (summed, un-normalized). ``x_mb``/``target_mb``:
-    ``[M, mb, ...]`` replicated over ``pp``. Returns
-    ``(loss_sum, dparams_local)`` with loss replicated and dparams the
-    local stage slice — manual backprop, nothing differentiates through
-    the scan.
-    """
-    s_count = jax.lax.axis_size(axis)
-    my = jax.lax.axis_index(axis)
-    fwd_edges = [(i, i + 1) for i in range(s_count - 1)]
-    bwd_edges = [(i + 1, i) for i in range(s_count - 1)]
-
-    mb_shape = x_mb.shape[1:]
-    varying = lambda z: jax.lax.pcast(z, (axis,), to="varying")
-    zero_mb = varying(jnp.zeros(mb_shape, x_mb.dtype))
-    x_stash0 = varying(jnp.zeros((sched.act_slots,) + mb_shape, x_mb.dtype))
-    g_stash0 = varying(
-        jnp.zeros((sched.grad_slots,) + mb_shape, jnp.float32)
-    )
-    dparams0 = jax.tree.map(
-        lambda p: varying(jnp.zeros(p.shape, jnp.float32)), params_local
-    )
-
-    def pick(table):  # [S] per-tick row → this device's entry
-        return jax.lax.dynamic_index_in_dim(table, my, 0, keepdims=False)
-
-    def tick(carry, row):
-        x_stash, g_stash, y_recv, g_recv, dparams, loss_acc = carry
-
-        # 1. Stash values that arrived over the carry wire.
-        rs = pick(row["recv_slot"])
-        x_stash = jnp.where(
-            rs >= 0,
-            jax.lax.dynamic_update_index_in_dim(
-                x_stash, y_recv, jnp.clip(rs, 0, sched.act_slots - 1), 0
-            ),
-            x_stash,
-        )
-        gs_in = pick(row["grecv_slot"])
-        g_stash = jnp.where(
-            gs_in >= 0,
-            jax.lax.dynamic_update_index_in_dim(
-                g_stash, g_recv, jnp.clip(gs_in, 0, sched.grad_slots - 1), 0
-            ),
-            g_stash,
-        )
-
-        # 2. Backward: rematerialize the stage forward under vjp.
-        b_mb = pick(row["b_mb"])
-        b_on = b_mb >= 0
-        x_saved = jax.lax.dynamic_index_in_dim(
-            x_stash, jnp.clip(pick(row["b_slot"]), 0, sched.act_slots - 1),
-            0, keepdims=False,
-        )
-        y_re, vjp = jax.vjp(block_fn, params_local, x_saved)
-        tgt = jax.lax.dynamic_index_in_dim(
-            target_mb, jnp.clip(b_mb, 0, sched.microbatches - 1), 0,
-            keepdims=False,
-        )
-        loss_mb, g_loss = loss_grad_fn(y_re, tgt)
-        g_mid = jax.lax.dynamic_index_in_dim(
-            g_stash, jnp.clip(pick(row["b_gslot"]), 0, sched.grad_slots - 1),
-            0, keepdims=False,
-        )
-        g_in = jnp.where(my == s_count - 1, g_loss, g_mid)
-        dp, dx = vjp(g_in.astype(y_re.dtype))
-        # where, not multiply-by-mask: bubble ticks rematerialize over
-        # stale stash contents, and a non-polynomial loss_grad_fn can
-        # emit NaN there — 0 * NaN would still poison the accumulator.
-        dparams = jax.tree.map(
-            lambda a, d: a + jnp.where(b_on, d.astype(jnp.float32), 0.0),
-            dparams, dp,
-        )
-        loss_acc = loss_acc + jnp.where(
-            b_on & (my == s_count - 1), loss_mb.astype(jnp.float32), 0.0
-        )
-        dx = jnp.where(b_on, dx.astype(jnp.float32), 0.0)
-
-        # 3. Forward.
-        f_mb = pick(row["f_mb"])
-        f_on = f_mb >= 0
-        f_slot = jnp.clip(pick(row["f_slot"]), 0, sched.act_slots - 1)
-        feed = jax.lax.dynamic_index_in_dim(
-            x_mb, jnp.clip(f_mb, 0, sched.microbatches - 1), 0, keepdims=False
-        )
-        x_in = jnp.where(my == 0, feed,
-                         jax.lax.dynamic_index_in_dim(
-                             x_stash, f_slot, 0, keepdims=False))
-        x_stash = jnp.where(
-            f_on, jax.lax.dynamic_update_index_in_dim(x_stash, x_in, f_slot, 0),
-            x_stash,
-        )
-        y_f = block_fn(params_local, x_in)
-        y_f = jnp.where(f_on, y_f, zero_mb)
-
-        # 4. Ship over the wire for tick t + 1.
-        y_next = (jax.lax.ppermute(y_f, axis, fwd_edges)
-                  if s_count > 1 else zero_mb)
-        g_next = (jax.lax.ppermute(dx, axis, bwd_edges)
-                  if s_count > 1
-                  else varying(jnp.zeros(mb_shape, jnp.float32)))
-
-        return (x_stash, g_stash, y_next, g_next, dparams, loss_acc), None
-
-    g_recv0 = varying(jnp.zeros(mb_shape, jnp.float32))
-    carry0 = (x_stash0, g_stash0, zero_mb, g_recv0, dparams0,
-              varying(jnp.zeros((), jnp.float32)))
-    (_, _, _, _, dparams, loss_acc), _ = jax.lax.scan(
-        tick, carry0, _sched_tables(sched)
-    )
-    # Loss accumulated on the last stage only → replicate across pp.
-    return jax.lax.psum(loss_acc, axis), dparams
-
-
 def _mse_loss_grad(y, target):
     """(sum-of-squares loss, dL/dy) for one microbatch — matches the
     GPipe train step's objective (pipeline.py make_pipeline_train_step)."""
@@ -380,26 +245,18 @@ def make_pipeline_train_step_1f1b(mesh: Mesh, cfg: PipelineConfig,
     Drop-in equal to :func:`tpu_p2p.models.pipeline.make_pipeline_train_step`
     (same loss normalization, same update), but with manual interleaved
     backprop and ``O(S)``-bounded activation memory.
+
+    Plain 1F1B is the ``chunks=1`` degeneration of the interleaved
+    schedule (stage-major and device-major layouts coincide, the ring's
+    wraparound edge goes unused), so the executor lives once, in
+    :func:`tpu_p2p.models.pipeline_interleaved.make_interleaved_train_step`;
+    this module keeps its own :func:`build_1f1b_schedule` as the
+    reference description of the classic warmup-then-alternate policy
+    (and for schedule analysis/tests).
     """
-    pp = _check_pp_mesh(mesh, cfg)
-    sched = build_1f1b_schedule(cfg.microbatches, cfg.stages)
+    # Lazy import: pipeline_interleaved imports helpers from this module.
+    from tpu_p2p.models.pipeline_interleaved import make_interleaved_train_step
 
-    def step(params, x, target):
-        x_mb = _to_microbatches(x, cfg.microbatches)
-        t_mb = _to_microbatches(target, cfg.microbatches)
-        loss_sum, grads = pipeline_1f1b_grads_local(
-            block_fn, loss_grad_fn, params, x_mb, t_mb, sched, pp
-        )
-        denom = float(np.prod(x.shape))
-        new_params = jax.tree.map(
-            lambda p, g: (p.astype(jnp.float32) - lr * g / denom).astype(p.dtype),
-            params, grads,
-        )
-        return new_params, loss_sum / denom
-
-    sm = jax.shard_map(
-        step, mesh=mesh,
-        in_specs=(pp_param_specs(mesh), P(), P()),
-        out_specs=(pp_param_specs(mesh), P()),
-    )
-    return jax.jit(sm)
+    _check_pp_mesh(mesh, cfg)
+    return make_interleaved_train_step(mesh, cfg, 1, block_fn=block_fn,
+                                       lr=lr, loss_grad_fn=loss_grad_fn)
