@@ -79,7 +79,7 @@ def _flagship_step_metrics(timing):
 
     mesh = F.build_mesh(1, devices=jax.devices()[:1])
     cfg = F.FlagshipConfig(
-        batch=4, seq=1024, heads=8, head_dim=64, stages=2, microbatches=2,
+        batch=8, seq=1024, heads=8, head_dim=64, stages=2, microbatches=1,
         num_experts=4, dtype="bfloat16",
     )
     import functools

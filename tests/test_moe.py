@@ -128,3 +128,46 @@ def test_top2_gates_renormalized():
     np.testing.assert_allclose(
         np.asarray(dispatch.sum(axis=(1, 2))), np.full(16, 2.0), atol=1e-6
     )
+
+
+def test_grouped_routing_matches_oracle_with_padding():
+    import dataclasses
+
+    # group_size 8 over 20 tokens -> 3 groups, 4 padded slots. With
+    # no-drop capacity the result must equal the capacity-free oracle.
+    cfg, params, x = _setup(g=20)
+    cfg = dataclasses.replace(cfg, group_size=8)
+    got = np.asarray(M.moe_layer_local(params, x, cfg, ep_axis=None))
+    want = np.asarray(M.moe_reference(params, x, cfg))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_grouped_ep_sharded_matches_unsharded():
+    import dataclasses
+
+    cfg, params, x = _setup(g=32, e=8)
+    cfg = dataclasses.replace(cfg, group_size=8)
+    local = np.asarray(M.moe_layer_local(params, x, cfg, ep_axis=None))
+    sharded = np.asarray(M.make_moe_layer(_ep_mesh(), cfg)(params, x))
+    np.testing.assert_allclose(sharded, local, atol=2e-5, rtol=2e-5)
+
+
+def test_padding_tokens_take_no_capacity():
+    # Direct unit test of _route_topk's valid mask (the layer pads the
+    # tail group with rows the mask must exclude): masked rows take no
+    # dispatch slots, and the real tokens' allocation is bit-identical
+    # to routing them alone — including top-2's cross-rank `used`
+    # accounting, where an unmasked pad's first choice would steal a
+    # slot from a real token's second choice.
+    cfg, params, x = _setup(g=8, e=4, cf=0.5)
+    cap = 2  # tight: drops are live, so stolen slots would show
+    xp = jnp.concatenate([x, jnp.zeros((8, x.shape[1]), x.dtype)])
+    valid = jnp.concatenate([jnp.ones(8), jnp.zeros(8)]).astype(jnp.float32)
+    d_masked, c_masked = M._route_topk(xp, params["router"], 4, cap, k=2,
+                                       valid=valid)
+    d_alone, c_alone = M._route_topk(x, params["router"], 4, cap, k=2)
+    np.testing.assert_array_equal(np.asarray(d_masked[8:]), 0.0)
+    np.testing.assert_array_equal(np.asarray(d_masked[:8]),
+                                  np.asarray(d_alone))
+    np.testing.assert_allclose(np.asarray(c_masked[:8]),
+                               np.asarray(c_alone), atol=1e-7)

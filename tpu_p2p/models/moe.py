@@ -14,12 +14,19 @@ TPU-first design notes:
   (tokens over capacity are dropped, their output is zero and the
   caller's residual carries them) — no gather/scatter with
   data-dependent shapes, which XLA cannot tile onto the MXU.
-- **Dispatch** builds ``[E, C, D]`` buffers; one tiled ``all_to_all``
-  along ``ep`` (split over the expert dim, concat over capacity) lands
-  each device's share ``[E/n, n·C, D]`` on the expert's owner; the
-  expert FFN is a batched einsum over the local expert dim; a second
-  ``all_to_all`` inverts the reshard; a combine einsum scatters expert
-  outputs back to token positions with their gate weights.
+- **Grouped routing.** Tokens route in fixed-width groups
+  (``MoEConfig.group_size``), capacity enforced *per group*: the
+  one-hot dispatch/combine tensors are ``[gs, E, C(gs)]`` per group —
+  linear in total tokens, where one all-token group would be
+  quadratic once ``C`` scales with ``G``. The tail group is padded
+  with masked rows that take no capacity.
+- **Dispatch** builds group-major ``[E, N·C, D]`` slot buffers; one
+  tiled ``all_to_all`` along ``ep`` (split over the expert dim, concat
+  over capacity) lands each device's share ``[E/n, n·N·C, D]`` on the
+  expert's owner; the expert FFN is a batched einsum over the local
+  expert dim; a second ``all_to_all`` inverts the reshard; a combine
+  einsum scatters expert outputs back to token positions with their
+  gate weights.
 - The routing math (cumsum-based capacity positions) runs in float32;
   expert matmuls stay in the payload dtype (bf16 on TPU) with float32
   accumulation via ``preferred_element_type``.
@@ -48,6 +55,12 @@ class MoEConfig:
     capacity_factor: float = 2.0
     router_top_k: int = 1  # 1 = Switch routing; 2 = GShard-style top-2
     # with renormalized gates
+    group_size: int = 1024  # routing-group width (GShard "groups"):
+    # capacity is enforced per group of this many tokens, so the
+    # one-hot dispatch/combine tensors are [gs, E, C(gs)] per group —
+    # O(G·gs) total instead of the O(G²) a single all-token group
+    # costs once C grows with G (measured: the dispatch einsums
+    # dominated the flagship step's time at B·T >= 4k tokens).
 
     def capacity(self, tokens: int) -> int:
         """Per-expert slot count for ``tokens`` routed tokens (each
@@ -73,7 +86,8 @@ def init_moe_params(cfg: MoEConfig, seed: int = 0, dtype=jnp.float32) -> Params:
     }
 
 
-def _route_topk(x, router_w, num_experts: int, capacity: int, k: int = 1):
+def _route_topk(x, router_w, num_experts: int, capacity: int, k: int = 1,
+                valid=None):
     """Top-``k`` routing with static capacity (Switch at k=1, GShard-
     style at k=2).
 
@@ -83,6 +97,8 @@ def _route_topk(x, router_w, num_experts: int, capacity: int, k: int = 1):
     first choices win slots over second choices, matching GShard's
     priority. Gates are the chosen experts' softmax probabilities
     renormalized over the k choices (dropped choices lose their mass).
+    ``valid`` (``[G]`` 0/1) masks padding tokens out of routing — they
+    take no capacity slots and contribute nothing.
     """
     logits = jnp.einsum("gd,de->ge", x.astype(jnp.float32),
                         router_w.astype(jnp.float32))
@@ -99,6 +115,8 @@ def _route_topk(x, router_w, num_experts: int, capacity: int, k: int = 1):
     used = jnp.zeros((num_experts,), jnp.float32)            # slots taken
     for r in range(k):  # k is tiny and static — unrolled
         onehot = jax.nn.one_hot(top_e[:, r], num_experts, dtype=jnp.float32)
+        if valid is not None:
+            onehot = onehot * valid[:, None]
         # Slot index within the expert: first-come order among this
         # rank's tokens, offset by slots earlier ranks consumed.
         pos = (jnp.cumsum(onehot, axis=0) - onehot + used[None, :]) * onehot
@@ -124,20 +142,34 @@ def moe_layer_local(params: Params, x, cfg: MoEConfig, ep_axis=None):
     n = jax.lax.axis_size(ep_axis) if ep_axis is not None else 1
     g, d = x.shape
     e = cfg.num_experts
-    cap = cfg.capacity(g)
     e_local = params["w1"].shape[0]
     if e_local * n != e:
         raise ValueError(
             f"expert shards ({e_local}) × ep size ({n}) != experts ({e})"
         )
 
-    dispatch, combine = _route_topk(x, params["router"], e, cap,
-                                    k=cfg.router_top_k)
-    # Gather routed tokens into per-expert slots: [E, C, D].
-    slots = jnp.einsum("gec,gd->ecd", dispatch.astype(x.dtype), x,
+    # Fixed-width routing groups keep the one-hot dispatch linear in
+    # token count (see MoEConfig.group_size). Pad the tail group with
+    # masked tokens that take no capacity.
+    gs = min(cfg.group_size, g) if cfg.group_size else g
+    ng = -(-g // gs)
+    pad = ng * gs - g
+    xg = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+    xg = xg.reshape(ng, gs, d)
+    valid = (jnp.arange(ng * gs) < g).astype(jnp.float32).reshape(ng, gs)
+    cap = cfg.capacity(gs)
+
+    dispatch, combine = jax.vmap(
+        lambda xx, vv: _route_topk(xx, params["router"], e, cap,
+                                   k=cfg.router_top_k, valid=vv)
+    )(xg, valid)                                    # [N, gs, E, C] each
+    # Gather routed tokens into per-expert slots across all groups:
+    # [E, N·C, D] (group-major capacity).
+    slots = jnp.einsum("Ngec,Ngd->eNcd", dispatch.astype(x.dtype), xg,
                        preferred_element_type=jnp.float32).astype(x.dtype)
+    slots = slots.reshape(e, ng * cap, d)
     if ep_axis is not None and n > 1:
-        # Ship each expert's slots to its owner: [E,C,D] → [E/n, n·C, D].
+        # Ship each expert's slots to its owner: [E,NC,D] → [E/n, n·NC, D].
         slots = jax.lax.all_to_all(slots, ep_axis, split_axis=0,
                                    concat_axis=1, tiled=True)
     h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", slots, params["w1"],
@@ -145,12 +177,15 @@ def moe_layer_local(params: Params, x, cfg: MoEConfig, ep_axis=None):
     y = jnp.einsum("ecf,efd->ecd", h.astype(x.dtype), params["w2"],
                    preferred_element_type=jnp.float32).astype(x.dtype)
     if ep_axis is not None and n > 1:
-        # Inverse reshard: [E/n, n·C, D] → [E, C, D] back at the source.
+        # Inverse reshard: [E/n, n·NC, D] → [E, NC, D] back at the source.
         y = jax.lax.all_to_all(y, ep_axis, split_axis=1, concat_axis=0,
                                tiled=True)
+    y = y.reshape(e, ng, cap, d)
     # Scatter expert outputs back to token positions, gate-weighted.
-    return jnp.einsum("gec,ecd->gd", combine.astype(y.dtype), y,
-                      preferred_element_type=jnp.float32).astype(x.dtype)
+    out = jnp.einsum("Ngec,eNcd->Ngd", combine.astype(y.dtype), y,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    out = out.reshape(ng * gs, d)
+    return out[:g] if pad else out
 
 
 def moe_reference(params: Params, x, cfg: MoEConfig):
