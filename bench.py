@@ -157,7 +157,8 @@ def main() -> int:
         # Strided subsample, not a row-major prefix: the prefix would be
         # almost entirely src=0 edges, biasing the "all-pairs" average
         # toward one device's egress links on big or multi-host meshes.
-        stride = max(1, len(all_p) // max_pairs)
+        stride = -(-len(all_p) // max_pairs)  # ceil: floor would
+        # degenerate to the row-major prefix for N in [max, 2max)
         pairs = all_p[::stride][:max_pairs]
         for i, (src, dst) in enumerate(pairs):
             # Differential unconditionally: the relay's block fence is

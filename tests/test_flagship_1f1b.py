@@ -1,0 +1,137 @@
+"""The flagship train step under the manual interleaved-1F1B executor:
+parity with the autodiff GPipe step across 5-axis mesh mixes, chunk
+counts, and SP strategies."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from tpu_p2p.models import flagship as F
+
+
+def _mesh(dp=1, pp=1, sp=1, tp=1, ep=1):
+    n = dp * pp * sp * tp * ep
+    return Mesh(
+        np.array(jax.devices()[:n]).reshape(dp, pp, sp, tp, ep), F.AXES
+    )
+
+
+def _cfg(**kw):
+    base = dict(batch=8, seq=16, heads=4, head_dim=8, stages=4,
+                microbatches=2, num_experts=2, capacity_factor=4.0)
+    base.update(kw)
+    return F.FlagshipConfig(**base)
+
+
+@pytest.mark.parametrize(
+    "mesh_kw,chunks",
+    [
+        (dict(pp=2), 1),
+        (dict(pp=2), 2),
+        (dict(pp=4), 1),
+        (dict(pp=2, dp=2), 1),
+        (dict(pp=2, sp=2), 1),
+        (dict(pp=2, tp=2), 1),
+        (dict(pp=2, ep=2), 1),
+        (dict(pp=2, dp=2, tp=2), 2),
+    ],
+    ids=["pp2", "pp2v2", "pp4", "pp2dp2", "pp2sp2", "pp2tp2", "pp2ep2",
+         "pp2dp2tp2v2"],
+)
+def test_1f1b_flagship_matches_gpipe(mesh_kw, chunks):
+    mesh = _mesh(**mesh_kw)
+    cfg = _cfg()
+    params = F.init_flagship_params(cfg)
+    x, t = F.flagship_example_batch(cfg, mesh)
+
+    p_gp = F.place_flagship_params(params, mesh)
+    want, l_gp = F.make_flagship_train_step(mesh, cfg, lr=1e-2)(p_gp, x, t)
+
+    p_fb = F.place_flagship_params_pipelined(params, mesh, cfg, chunks)
+    got_dm, l_fb = F.make_flagship_train_step_1f1b(
+        mesh, cfg, lr=1e-2, chunks=chunks
+    )(p_fb, x, t)
+    got = F.unplace_flagship_params_pipelined(got_dm, mesh, cfg, chunks)
+
+    np.testing.assert_allclose(float(l_fb), float(l_gp), atol=1e-5, rtol=1e-5)
+    for k in params:
+        np.testing.assert_allclose(
+            got[k], np.asarray(want[k]), atol=2e-5, rtol=2e-5, err_msg=k
+        )
+
+
+def test_1f1b_flagship_ulysses_sp():
+    mesh = _mesh(pp=2, sp=2)
+    cfg = _cfg(sp_strategy="ulysses")
+    params = F.init_flagship_params(cfg)
+    x, t = F.flagship_example_batch(cfg, mesh)
+    p_gp = F.place_flagship_params(params, mesh)
+    want, l_gp = F.make_flagship_train_step(mesh, cfg, lr=1e-2)(p_gp, x, t)
+    p_fb = F.place_flagship_params_pipelined(params, mesh, cfg, 1)
+    got_dm, l_fb = F.make_flagship_train_step_1f1b(mesh, cfg, lr=1e-2)(
+        p_fb, x, t
+    )
+    got = F.unplace_flagship_params_pipelined(got_dm, mesh, cfg, 1)
+    np.testing.assert_allclose(float(l_fb), float(l_gp), atol=1e-5, rtol=1e-5)
+    for k in params:
+        np.testing.assert_allclose(got[k], np.asarray(want[k]),
+                                   atol=2e-5, rtol=2e-5, err_msg=k)
+
+
+def test_1f1b_flagship_training_decreases_loss():
+    mesh = _mesh(pp=2, dp=2, sp=2)
+    cfg = _cfg()
+    params = F.place_flagship_params_pipelined(
+        F.init_flagship_params(cfg), mesh, cfg, 1
+    )
+    x, t = F.flagship_example_batch(cfg, mesh)
+    # lr tuned to this config's large initial loss — the GPipe step
+    # diverges identically at bigger steps, so this pins optimization,
+    # not the executor.
+    step = F.make_flagship_train_step_1f1b(mesh, cfg, lr=2e-6)
+    losses = []
+    for _ in range(4):
+        params, loss = step(params, x, t)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_1f1b_flagship_validation():
+    cfg = _cfg()
+    with pytest.raises(ValueError, match="divide"):
+        F.make_flagship_train_step_1f1b(_mesh(pp=2), cfg, chunks=3)
+    with pytest.raises(ValueError, match="zero_dp"):
+        F.make_flagship_train_step_1f1b(_mesh(pp=2, dp=2),
+                                        _cfg(zero_dp=True))
+
+
+def test_pipelined_stage_perm_roundtrip():
+    cfg = _cfg(stages=8)
+    mesh = _mesh(pp=2)
+    params = F.init_flagship_params(cfg)
+    dm = F.place_flagship_params_pipelined(params, mesh, cfg, 2)
+    back = F.unplace_flagship_params_pipelined(dm, mesh, cfg, 2)
+    for k in params:
+        np.testing.assert_array_equal(back[k], np.asarray(params[k]))
+
+
+def test_flagship_pipelined_bundle():
+    mesh = _mesh(pp=2)
+    cfg = _cfg(stages=8)
+    fp = F.FlagshipPipelined(mesh, cfg, chunks=2, lr=1e-2)
+    params0 = F.init_flagship_params(cfg)
+    x, t = F.flagship_example_batch(cfg, mesh)
+    params, loss = fp.step(fp.place(params0), x, t)
+    assert np.isfinite(float(loss))
+    # Bundle result equals the loose-function path with matching chunks.
+    want, _ = F.make_flagship_train_step_1f1b(mesh, cfg, lr=1e-2, chunks=2)(
+        F.place_flagship_params_pipelined(params0, mesh, cfg, 2), x, t
+    )
+    for k in params0:
+        np.testing.assert_allclose(np.asarray(fp.unplace(params)[k]),
+                                   np.asarray(
+                                       F.unplace_flagship_params_pipelined(
+                                           want, mesh, cfg, 2)[k]),
+                                   atol=1e-6, err_msg=k)

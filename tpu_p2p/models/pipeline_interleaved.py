@@ -231,20 +231,31 @@ def build_interleaved_schedule(microbatches: int, devices: int,
     )
 
 
-def to_device_major(stage_major: np.ndarray, n: int, v: int) -> np.ndarray:
-    """Reorder a ``[n·v, …]`` stage-major param array so row
-    ``d·v + c`` holds virtual stage ``d + c·n``."""
-    idx = [d + c * n for d in range(n) for c in range(v)]
-    return stage_major[idx]
+def device_major_perm(n: int, v: int, chunk_rows: int = 1):
+    """Stage-axis permutation into device-major chunk order: row group
+    ``(d, c)`` holds the ``chunk_rows`` consecutive rows of virtual
+    stage ``d + c·n``, so ``P('pp')`` sharding hands device ``d``
+    exactly its ``v`` chunks."""
+    return [
+        (d + c * n) * chunk_rows + j
+        for d in range(n) for c in range(v) for j in range(chunk_rows)
+    ]
 
 
-def from_device_major(dev_major: np.ndarray, n: int, v: int) -> np.ndarray:
+def to_device_major(stage_major: np.ndarray, n: int, v: int,
+                    chunk_rows: int = 1) -> np.ndarray:
+    """Reorder a ``[n·v·chunk_rows, …]`` stage-major param array into
+    device-major chunk order (see :func:`device_major_perm`)."""
+    return stage_major[np.asarray(device_major_perm(n, v, chunk_rows))]
+
+
+def from_device_major(dev_major: np.ndarray, n: int, v: int,
+                      chunk_rows: int = 1) -> np.ndarray:
     """Inverse of :func:`to_device_major`."""
-    out = np.empty_like(dev_major)
-    for d in range(n):
-        for c in range(v):
-            out[d + c * n] = dev_major[d * v + c]
-    return out
+    perm = np.asarray(device_major_perm(n, v, chunk_rows))
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm))
+    return np.asarray(dev_major)[inv]
 
 
 def _sched_tables(s: InterleavedSchedule):
@@ -257,13 +268,29 @@ def _sched_tables(s: InterleavedSchedule):
 
 def interleaved_grads_local(block_fn: Callable, loss_grad_fn: Callable,
                             params_local: Params, x_mb, target_mb,
-                            sched: InterleavedSchedule, axis: str):
+                            sched: InterleavedSchedule, axis: str,
+                            chunk_rows: int = 1,
+                            vma_axes: Tuple[str, ...] = (),
+                            dparam_vma=None):
     """Run the interleaved schedule — call inside ``shard_map``.
 
-    ``params_local`` leaves: the device's ``[v, …]`` chunk-major slice
-    (device-major layout, see module docstring). ``block_fn(chunk, x)``
-    applies ONE virtual stage given its ``[1, …]`` param slice.
-    Returns ``(loss_sum replicated, dparams_local [v, …])``.
+    ``params_local`` leaves: the device's ``[v·chunk_rows, …]``
+    chunk-major slice (device-major layout, see module docstring).
+    ``block_fn(chunk, x)`` applies ONE virtual stage given its
+    ``[chunk_rows, …]`` param slice (a chunk may hold several
+    consecutive sub-blocks, e.g. the flagship's transformer layers).
+    Returns ``(loss_sum replicated over ``axis``, dparams_local)``.
+
+    ``vma_axes``: extra mesh axes of the *enclosing* shard_map the
+    activation/gradient/loss carries must be typed varying over (the
+    flagship wraps this executor in its full 5-axis shard_map; the
+    carries acquire dp/sp/ep variance from the data — but NOT tp
+    variance, since tensor-parallel blocks psum back to replicated
+    activations). ``dparam_vma``: optional pytree (matching
+    ``params_local``) of per-leaf axis tuples for the gradient
+    accumulators — tp-sharded weights produce genuinely tp-varying
+    cotangents while replicated leaves (the router) do not, and the
+    zero accumulators must start with each leaf's true typing.
     """
     n = jax.lax.axis_size(axis)
     my = jax.lax.axis_index(axis)
@@ -273,22 +300,30 @@ def interleaved_grads_local(block_fn: Callable, loss_grad_fn: Callable,
     bwd_edges = [((i + 1) % n, i) for i in range(n)]
 
     mb_shape = x_mb.shape[1:]
-    varying = lambda z: jax.lax.pcast(z, (axis,), to="varying")
+    all_axes = (axis,) + tuple(a for a in vma_axes if a != axis)
+    varying = lambda z: jax.lax.pcast(z, all_axes, to="varying")
     zero_mb = varying(jnp.zeros(mb_shape, x_mb.dtype))
     x_stash0 = varying(jnp.zeros((sched.act_slots,) + mb_shape, x_mb.dtype))
     g_stash0 = varying(jnp.zeros((sched.grad_slots,) + mb_shape, jnp.float32))
-    dparams0 = jax.tree.map(
-        lambda p: varying(jnp.zeros(p.shape, jnp.float32)), params_local
-    )
+    if dparam_vma is None:
+        dparams0 = jax.tree.map(
+            lambda p: varying(jnp.zeros(p.shape, jnp.float32)), params_local
+        )
+    else:
+        dparams0 = jax.tree.map(
+            lambda p, ax: jax.lax.pcast(
+                jnp.zeros(p.shape, jnp.float32), tuple(ax), to="varying"
+            ),
+            params_local, dparam_vma,
+        )
 
     def pick(table):
         return jax.lax.dynamic_index_in_dim(table, my, 0, keepdims=False)
 
     def chunk_of(params, cidx):
+        start = jnp.clip(cidx, 0, v - 1) * chunk_rows
         return jax.tree.map(
-            lambda p: jax.lax.dynamic_index_in_dim(
-                p, jnp.clip(cidx, 0, v - 1), 0, keepdims=True
-            ),
+            lambda p: jax.lax.dynamic_slice_in_dim(p, start, chunk_rows, 0),
             params,
         )
 
@@ -335,12 +370,12 @@ def interleaved_grads_local(block_fn: Callable, loss_grad_fn: Callable,
         is_last = (my == n - 1) & (b_cidx == v - 1)
         g_in = jnp.where(is_last, g_loss, g_mid)
         dchunk, dx = vjp(g_in.astype(y_re.dtype))
-        b_idx = jnp.clip(b_cidx, 0, v - 1)
+        b_start = jnp.clip(b_cidx, 0, v - 1) * chunk_rows
 
         def accum(acc, dc):
-            cur = jax.lax.dynamic_slice_in_dim(acc, b_idx, 1, 0)
+            cur = jax.lax.dynamic_slice_in_dim(acc, b_start, chunk_rows, 0)
             upd = jax.lax.dynamic_update_slice_in_dim(
-                acc, cur + dc.astype(jnp.float32), b_idx, 0
+                acc, cur + dc.astype(jnp.float32), b_start, 0
             )
             return jnp.where(b_on, upd, acc)
 
