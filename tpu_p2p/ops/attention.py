@@ -105,8 +105,35 @@ def _merge(o, m, l, s, v):
     return o_new, m_new, l_new
 
 
+def zigzag_chunks(rank, n: int, t_local: int):
+    """Global start positions of a rank's two zigzag half-chunks.
+
+    Zigzag layout: the sequence is cut into ``2n`` chunks of
+    ``t_local/2``; rank ``r`` holds chunks ``(r, 2n-1-r)`` — one early,
+    one mirrored late — so every rank's *live* causal work per ring hop
+    is equal. (With contiguous blocks, rank 0's KV is visible to all
+    queries while rank ``n-1``'s is visible to almost none; a flash
+    kernel that skips fully-masked tiles then leaves later ranks idle
+    at each ring sync.) ``rank`` may be traced (``axis_index``).
+    """
+    half = t_local // 2
+    return rank * half, (2 * n - 1 - rank) * half
+
+
+def _block_positions(src_block, n: int, t: int, layout: str):
+    """Global positions ``[t]`` of a (possibly traced) block index."""
+    if layout == "zigzag":
+        lo, hi = zigzag_chunks(src_block, n, t)
+        half = t // 2
+        return jnp.concatenate(
+            [lo + jnp.arange(half), hi + jnp.arange(half)]
+        )
+    return src_block * t + jnp.arange(t)
+
+
 def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
-                         use_flash: bool = False):
+                         use_flash: bool = False,
+                         layout: str = "contiguous"):
     """Per-shard ring attention body — call inside ``shard_map``.
 
     ``q, k, v``: local blocks ``[B, H, T_local, D]``, sequence sharded
@@ -125,10 +152,21 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
     kernel (:func:`tpu_p2p.ops.flash_attention.flash_carry_block`) —
     the forward/benchmark fast path; keep the default jnp path for
     training (the Pallas carry step has no VJP).
+
+    ``layout="zigzag"`` expects inputs pre-sharded in the zigzag order
+    (:func:`to_zigzag`) and returns output in the same order — the
+    load-balanced causal layout (see :func:`zigzag_chunks`); requires
+    even ``T_local``. On the flash path each hop becomes four
+    half-block kernel calls (each half is contiguous, which the
+    kernel's offset-based masking needs), preserving tile skipping.
     """
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, t, d = q.shape
+    if layout == "zigzag" and t % 2:
+        raise ValueError(f"zigzag needs an even local length, got {t}")
     scale = 1.0 / math.sqrt(d)
     edges = [(i, (i + 1) % n) for i in range(n)]
 
@@ -136,16 +174,39 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
     m = jnp.full((b, h, t), NEG_INF, jnp.float32)
     l = jnp.zeros((b, h, t), jnp.float32)
 
-    q_pos = my * t + jnp.arange(t)  # global query positions
+    q_pos = _block_positions(my, n, t, layout)  # global query positions
 
     def block_mask(s, src_block):
         if not causal:
             return s
-        k_pos = src_block * t + jnp.arange(t)
+        k_pos = _block_positions(src_block, n, t, layout)
         visible = q_pos[:, None] >= k_pos[None, :]
         return jnp.where(visible[None, None], s, NEG_INF)
 
     def accumulate(o, m, l, k_blk, v_blk, src_block):
+        # The half-block split exists only for the causal offset math;
+        # non-causal hops use the cheaper single full-block call.
+        if use_flash and layout == "zigzag" and causal:
+            from tpu_p2p.ops.flash_attention import flash_carry_block
+
+            half = t // 2
+            q_lo, q_hi = zigzag_chunks(my, n, t)
+            k_lo, k_hi = zigzag_chunks(src_block, n, t)
+            # Four contiguous half×half passes; each q half's carry
+            # slice accumulates over both KV halves.
+            for qs, q_off in ((slice(0, half), q_lo),
+                              (slice(half, t), q_hi)):
+                oq, mq, lq = o[:, :, qs], m[:, :, qs], l[:, :, qs]
+                for ks, k_off in ((slice(0, half), k_lo),
+                                  (slice(half, t), k_hi)):
+                    oq, mq, lq = flash_carry_block(
+                        q[:, :, qs], k_blk[:, :, ks], v_blk[:, :, ks],
+                        oq, mq, lq, q_off, k_off, causal=causal,
+                    )
+                o = o.at[:, :, qs].set(oq)
+                m = m.at[:, :, qs].set(mq)
+                l = l.at[:, :, qs].set(lq)
+            return o, m, l
         if use_flash:
             from tpu_p2p.ops.flash_attention import flash_carry_block
 
@@ -181,18 +242,20 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
 
 @functools.lru_cache(maxsize=None)
 def ring_attention(mesh: Mesh, axis: str, causal: bool = False,
-                   use_flash: bool = False):
+                   use_flash: bool = False, layout: str = "contiguous"):
     """Jitted global ring attention over ``mesh``.
 
     Takes global ``[B, H, T, D]`` arrays with ``T`` sharded along
     ``axis`` (other mesh axes unused here — the model layer in
     :mod:`tpu_p2p.models.ring_transformer` composes dp/tp on top).
+    With ``layout="zigzag"``, inputs and output are in the zigzag
+    sequence order (:func:`to_zigzag`).
     """
     spec = P(None, None, axis, None)
 
     def f(q, k, v):
         return ring_attention_local(q, k, v, axis, causal=causal,
-                                    use_flash=use_flash)
+                                    use_flash=use_flash, layout=layout)
 
     # check_vma=False on the flash path: JAX's varying-manual-axes
     # tracking mis-propagates through pallas_call (its own error text
@@ -201,6 +264,34 @@ def ring_attention(mesh: Mesh, axis: str, causal: bool = False,
         jax.shard_map(f, mesh=mesh, in_specs=(spec, spec, spec),
                       out_specs=spec, check_vma=not use_flash)
     )
+
+
+def zigzag_perm(n: int, seq: int):
+    """Sequence-axis permutation into zigzag order: shard ``r`` of the
+    permuted sequence holds chunks ``(r, 2n-1-r)`` of the original."""
+    if seq % (2 * n):
+        raise ValueError(f"sequence {seq} must divide by 2n = {2 * n}")
+    half = seq // (2 * n)
+    perm = []
+    for r in range(n):
+        perm.extend(range(r * half, (r + 1) * half))
+        perm.extend(range((2 * n - 1 - r) * half, (2 * n - r) * half))
+    return perm
+
+
+def to_zigzag(x, n: int, seq_axis: int = 2):
+    """Reorder the sequence axis into zigzag layout (host or device)."""
+    perm = jnp.asarray(zigzag_perm(n, x.shape[seq_axis]))
+    return jnp.take(x, perm, axis=seq_axis)
+
+
+def from_zigzag(x, n: int, seq_axis: int = 2):
+    """Inverse of :func:`to_zigzag`."""
+    perm = zigzag_perm(n, x.shape[seq_axis])
+    inv = [0] * len(perm)
+    for i, p in enumerate(perm):
+        inv[p] = i
+    return jnp.take(x, jnp.asarray(inv), axis=seq_axis)
 
 
 def attention_sharding(mesh: Mesh, axis: str) -> NamedSharding:
