@@ -113,3 +113,49 @@ def test_zigzag_rejects_odd_local_length():
     q2, k2, v2 = _qkv(t=6)  # local length 3 → odd
     with pytest.raises(ValueError, match="even"):
         fn(q2, k2, v2)
+
+
+def test_flagship_ring_zigzag_strategy():
+    # The flagship treats its sequence axis as zigzag-ordered: the
+    # forward on zigzag-permuted data must equal the contiguous-ring
+    # forward's output permuted the same way, and a train step must
+    # produce identical parameter updates (params see no positions).
+    from tpu_p2p.models import flagship as F
+
+    mesh = Mesh(
+        np.array(jax.devices()).reshape(2, 1, 4, 1, 1), F.AXES
+    )
+    cfg_ring = F.FlagshipConfig(batch=4, seq=64, heads=4, head_dim=8,
+                                stages=2, microbatches=1, num_experts=2,
+                                capacity_factor=4.0)
+    import dataclasses
+
+    cfg_zig = dataclasses.replace(cfg_ring, sp_strategy="ring_zigzag")
+    params = F.place_flagship_params(F.init_flagship_params(cfg_ring), mesh)
+    x, t = F.flagship_example_batch(cfg_ring, mesh)
+    zx = A.to_zigzag(x, 4, seq_axis=1)
+    zt = A.to_zigzag(t, 4, seq_axis=1)
+
+    want = F.make_flagship_forward(mesh, cfg_ring)(params, x)
+    got = F.make_flagship_forward(mesh, cfg_zig)(params, zx)
+    np.testing.assert_allclose(
+        np.asarray(A.from_zigzag(got, 4, seq_axis=1)), np.asarray(want),
+        atol=2e-5, rtol=2e-5,
+    )
+
+    p_ring, l_ring = F.make_flagship_train_step(mesh, cfg_ring, lr=1e-3)(
+        params, x, t)
+    p_zig, l_zig = F.make_flagship_train_step(mesh, cfg_zig, lr=1e-3)(
+        params, zx, zt)
+    np.testing.assert_allclose(float(l_zig), float(l_ring), rtol=1e-6)
+    for k in p_ring:
+        np.testing.assert_allclose(np.asarray(p_zig[k]),
+                                   np.asarray(p_ring[k]),
+                                   atol=2e-5, rtol=2e-5, err_msg=k)
+
+
+def test_flagship_rejects_unknown_sp_strategy():
+    from tpu_p2p.models import flagship as F
+
+    with pytest.raises(ValueError, match="sp_strategy"):
+        F.FlagshipConfig(sp_strategy="zigzag")

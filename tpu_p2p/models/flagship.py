@@ -70,9 +70,15 @@ class FlagshipConfig:
     moe_mult: int = 2        # expert FFN width = moe_mult * model_dim
     causal: bool = True
     dtype: str = "float32"
-    sp_strategy: str = "ring"  # "ring" (ppermute KV rotation) or
-    # "ulysses" (head<->seq all_to_all) — the two SP families of
-    # SURVEY.md §2.3; ulysses needs heads % sp == 0
+    sp_strategy: str = "ring"  # "ring" (ppermute KV rotation),
+    # "ring_zigzag" (same transport, load-balanced causal layout — the
+    # model then treats its sequence axis as zigzag-ordered, see
+    # tpu_p2p.ops.attention.to_zigzag; attention is the only
+    # position-dependent op, so reordering the data suffices — exactly
+    # equivalent under no-drop MoE capacity, and with tight capacity
+    # the dropped-token set differs by shard co-location, like any
+    # resharding), or "ulysses" (head<->seq all_to_all). SURVEY.md
+    # §2.3's SP families; ulysses needs heads % sp == 0
     zero_dp: bool = False    # ZeRO-3/FSDP: params (and thus grads +
     # optimizer moments) sharded over dp, all-gathered on use inside
     # the step; autodiff turns the gather's transpose into the ZeRO
@@ -82,6 +88,16 @@ class FlagshipConfig:
     # the full sequence, so the custom-vjp kernel drops in) and with
     # sp size 1; the ring path's streaming-carry kernel is
     # forward-only, so ring + use_flash raises.
+
+    def __post_init__(self) -> None:
+        # Strict, because a typo ("zigzag", "ring-zigzag") would fall
+        # through to the contiguous layout and train silently wrong on
+        # zigzag-permuted data.
+        if self.sp_strategy not in ("ring", "ring_zigzag", "ulysses"):
+            raise ValueError(
+                f"unknown sp_strategy {self.sp_strategy!r}; expected "
+                "'ring', 'ring_zigzag', or 'ulysses'"
+            )
 
     @property
     def model_dim(self) -> int:
@@ -229,7 +245,10 @@ def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
                 "use_flash requires sp_strategy='ulysses' (or sp size 1): "
                 "the ring path's streaming flash kernel is forward-only"
             )
-        a = ring_attention_local(q, k, v, sp, causal=cfg.causal)
+        layout = ("zigzag" if cfg.sp_strategy == "ring_zigzag"
+                  else "contiguous")
+        a = ring_attention_local(q, k, v, sp, causal=cfg.causal,
+                                 layout=layout)
     elif cfg.use_flash:  # size-1 sp (or no sp axis): sequence is local
         from tpu_p2p.ops.flash_attention import flash_attention
 

@@ -31,7 +31,7 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
     rt, cfg = ctx.rt, ctx.cfg
     mesh = F.build_mesh(rt.num_devices, devices=list(rt.devices))
     mc = model_cfg or F.FlagshipConfig().tiny(mesh)
-    if mc.sp_strategy not in ("ring", "ulysses"):
+    if mc.sp_strategy not in ("ring", "ring_zigzag", "ulysses"):
         raise ValueError(f"unknown sp_strategy {mc.sp_strategy!r}")
     if model_cfg is None and cfg.dtype in ("bfloat16", "float32"):
         mc = dataclasses.replace(mc, dtype=cfg.dtype)
