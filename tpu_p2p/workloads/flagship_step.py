@@ -31,8 +31,7 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
     rt, cfg = ctx.rt, ctx.cfg
     mesh = F.build_mesh(rt.num_devices, devices=list(rt.devices))
     mc = model_cfg or F.FlagshipConfig().tiny(mesh)
-    if mc.sp_strategy not in ("ring", "ring_zigzag", "ulysses"):
-        raise ValueError(f"unknown sp_strategy {mc.sp_strategy!r}")
+    # sp_strategy is validated by FlagshipConfig.__post_init__.
     if model_cfg is None and cfg.dtype in ("bfloat16", "float32"):
         mc = dataclasses.replace(mc, dtype=cfg.dtype)
     params = F.place_flagship_params(F.init_flagship_params(mc), mesh)
