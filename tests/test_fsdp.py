@@ -122,4 +122,6 @@ def test_zero_dp_without_dp_axis_is_noop():
     mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("tp",))
     cfg = _cfg(zero_dp=True, heads=4)
     specs = F.flagship_param_specs(mesh, cfg)
-    assert specs == F._base_param_specs(mesh)
+    base = F._base_param_specs(mesh)
+    base.pop("emb")  # no vocab in this cfg → no emb leaf
+    assert specs == base

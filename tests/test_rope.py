@@ -1,0 +1,119 @@
+"""RoPE: math properties, SP-strategy invariance (positions travel
+with tokens through ring / zigzag / Ulysses), and KV-cached decode."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpu_p2p.models import decode as D
+from tpu_p2p.models import flagship as F
+from tpu_p2p.ops import attention as A
+from tpu_p2p.ops.rope import apply_rope, rope_angles
+
+
+# ------------------------------------------------------------------ math
+
+
+def test_rope_preserves_norm_and_zero_position():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 3, 8, 16)), jnp.float32)
+    pos = jnp.arange(8)
+    y = apply_rope(x, pos)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(y), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5,
+    )
+    # Position 0 is the identity rotation.
+    np.testing.assert_allclose(np.asarray(y[:, :, 0]),
+                               np.asarray(x[:, :, 0]), atol=1e-6)
+
+
+def test_rope_scores_depend_on_relative_position_only():
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((1, 1, 1, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 1, 1, 32)), jnp.float32)
+
+    def score(pq, pk):
+        qq = apply_rope(q, jnp.asarray([pq]))
+        kk = apply_rope(k, jnp.asarray([pk]))
+        return float(jnp.sum(qq * kk))
+
+    assert score(5, 3) == pytest.approx(score(12, 10), rel=1e-5)
+    assert score(5, 3) != pytest.approx(score(5, 4), rel=1e-3)
+
+
+def test_rope_rejects_odd_head_dim():
+    with pytest.raises(ValueError, match="even"):
+        rope_angles(jnp.arange(4), 7)
+
+
+# ------------------------------------------------- SP-strategy invariance
+
+
+def _cfg(**kw):
+    base = dict(batch=4, seq=64, heads=4, head_dim=8, stages=2,
+                microbatches=1, num_experts=2, capacity_factor=4.0,
+                rope=True)
+    base.update(kw)
+    return F.FlagshipConfig(**base)
+
+
+def _mesh(sp=1):
+    shape = (1, 1, sp, 1, 1)
+    return Mesh(np.array(jax.devices()[:sp]).reshape(shape), F.AXES)
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ring_zigzag", "ulysses"])
+def test_roped_sp_forward_matches_single_device(strategy):
+    cfg = _cfg(sp_strategy=strategy)
+    params = F.init_flagship_params(cfg)
+    mesh1 = _mesh(1)
+    x1, _ = F.flagship_example_batch(cfg, mesh1)
+    want = np.asarray(
+        F.make_flagship_forward(mesh1, cfg)(
+            F.place_flagship_params(params, mesh1), x1
+        )
+    )
+    mesh4 = _mesh(4)
+    placed = F.place_flagship_params(params, mesh4)
+    x4, _ = F.flagship_example_batch(cfg, mesh4)  # same seed/values
+    if strategy == "ring_zigzag":
+        zx = A.to_zigzag(x4, 4, seq_axis=1)
+        got = A.from_zigzag(
+            F.make_flagship_forward(mesh4, cfg)(placed, zx), 4, seq_axis=1
+        )
+    else:
+        got = F.make_flagship_forward(mesh4, cfg)(placed, x4)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-5, rtol=2e-5)
+
+
+def test_rope_changes_the_output():
+    cfg_on, cfg_off = _cfg(), _cfg(rope=False)
+    mesh = _mesh(1)
+    params = F.place_flagship_params(F.init_flagship_params(cfg_on), mesh)
+    x, _ = F.flagship_example_batch(cfg_on, mesh)
+    on = F.make_flagship_forward(mesh, cfg_on)(params, x)
+    off = F.make_flagship_forward(mesh, cfg_off)(params, x)
+    assert float(jnp.max(jnp.abs(on - off))) > 1e-3
+
+
+# ------------------------------------------------------------------ decode
+
+
+def test_roped_decode_matches_causal_forward():
+    cfg = _cfg(seq=8, microbatches=2, batch=8)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 1, 1, 2, 1), F.AXES)
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    x_full, _ = F.flagship_example_batch(cfg, mesh)
+    want = np.asarray(F.make_flagship_forward(mesh, cfg)(params, x_full))
+    step = D.make_flagship_decode_step(mesh, cfg)
+    cache = D.init_kv_cache(cfg, max_len=cfg.seq, mesh=mesh)
+    for t in range(cfg.seq):
+        cache, y_t = step(params, cache, x_full[:, t:t + 1, :], t)
+        np.testing.assert_allclose(np.asarray(y_t)[:, 0, :], want[:, t, :],
+                                   atol=1e-4, rtol=1e-4,
+                                   err_msg=f"position {t}")

@@ -98,6 +98,10 @@ def _decode_sub_block(sub, x, k_cache, v_cache, pos, cfg, tp, ep):
     """
     max_len = k_cache.shape[2]
     q = jnp.einsum("btm,hmd->bhtd", x, sub["wq"])     # [B, H, 1, Dh]
+    if cfg.rope:
+        from tpu_p2p.ops.rope import apply_rope
+
+        q = apply_rope(q, jnp.reshape(pos, (1,)))
     kw = repeat_kv(k_cache, q.shape[1])
     vw = repeat_kv(v_cache, q.shape[1])
     s = jnp.einsum("bhtd,bhTd->bhtT", q, kw,
@@ -143,10 +147,19 @@ def make_flagship_decode_step(mesh: Mesh, cfg: FlagshipConfig):
         k_all, v_all = cache["k"], cache["v"]
         x = x_t
         for s in range(cfg.stages):
-            sub = {kk: vv[s] for kk, vv in params.items()}
+            # Stage-major leaves only: 'emb' (vocab configs) has a
+            # vocab leading dim, not a stage one.
+            sub = {kk: vv[s] for kk, vv in params.items() if kk != "emb"}
             # Project and write this token's K/V at pos (time axis 2).
             k_t = jnp.einsum("btm,hmd->bhtd", x, sub["wk"])
             v_t = jnp.einsum("btm,hmd->bhtd", x, sub["wv"])
+            if cfg.rope:
+                # Cache stores roped K (standard): the new token's K is
+                # rotated by its position before the cache write, and
+                # this step's Q likewise inside the sub-block.
+                from tpu_p2p.ops.rope import apply_rope
+
+                k_t = apply_rope(k_t, jnp.reshape(pos, (1,)))
             k_st = jax.lax.dynamic_update_slice_in_dim(
                 k_all[s], k_t, pos, axis=2
             )

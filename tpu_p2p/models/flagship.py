@@ -88,6 +88,14 @@ class FlagshipConfig:
     # the full sequence, so the custom-vjp kernel drops in) and with
     # sp size 1; the ring path's streaming-carry kernel is
     # forward-only, so ring + use_flash raises.
+    rope: bool = False       # rotary position embeddings, applied to
+    # q/k per *global* position before any KV movement — so roped
+    # blocks rotate through the ring, reshard through Ulysses, or sit
+    # zigzag-permuted unchanged (tpu_p2p/ops/rope.py).
+    vocab: int = 0           # 0 = continuous regression (the default
+    # benchmark model); > 0 adds a tied token embedding ("emb",
+    # replicated) — inputs become int token ids, outputs logits, and
+    # make_flagship_lm_train_step trains with cross-entropy.
 
     def __post_init__(self) -> None:
         # Strict, because a typo ("zigzag", "ring-zigzag") would fall
@@ -152,7 +160,7 @@ def flagship_param_shapes(cfg: FlagshipConfig) -> Dict[str, Tuple[int, ...]]:
     s, h, hkv = cfg.stages, cfg.heads, cfg.num_kv_heads
     dm, dh = cfg.model_dim, cfg.head_dim
     e, f = cfg.num_experts, cfg.moe_mult * cfg.model_dim
-    return {
+    shapes = {
         "wq": (s, h, dm, dh),
         "wk": (s, hkv, dm, dh),
         "wv": (s, hkv, dm, dh),
@@ -161,10 +169,13 @@ def flagship_param_shapes(cfg: FlagshipConfig) -> Dict[str, Tuple[int, ...]]:
         "we1": (s, e, dm, f),
         "we2": (s, e, f, dm),
     }
+    if cfg.vocab:
+        shapes["emb"] = (cfg.vocab, dm)
+    return shapes
 
 
 _FAN_IN_DIM = {"wq": 2, "wk": 2, "wv": 2, "wo": 2, "router": 1,
-               "we1": 2, "we2": 2}
+               "we1": 2, "we2": 2, "emb": 1}
 
 
 def init_flagship_params(cfg: FlagshipConfig, seed: int = 0) -> Params:
@@ -189,6 +200,9 @@ def _base_param_specs(mesh: Mesh) -> Dict[str, P]:
         "router": P(pp, None, None),
         "we1": P(pp, ep, None, None),
         "we2": P(pp, ep, None, None),
+        "emb": P(None, None),  # tied embedding (vocab > 0); replicated
+        # (ZeRO may still dp-shard it via the plan). Extra keys are
+        # harmless for configs without a vocab.
     }
 
 
@@ -208,12 +222,16 @@ def _fsdp_plan(mesh: Mesh, cfg: Optional[FlagshipConfig]):
 def flagship_param_specs(mesh: Mesh,
                          cfg: Optional[FlagshipConfig] = None) -> Dict[str, P]:
     """Param shardings: pp stage-major, tp heads, ep experts — plus the
-    dp dim from the ZeRO plan when ``cfg.zero_dp`` is set."""
+    dp dim from the ZeRO plan when ``cfg.zero_dp`` is set. The result's
+    keys mirror the params pytree: ``emb`` only with a vocab."""
     from tpu_p2p.parallel import fsdp
 
     base = _base_param_specs(mesh)
     plan = _fsdp_plan(mesh, cfg)
-    return fsdp.fsdp_specs(base, plan, "dp") if plan else base
+    specs = fsdp.fsdp_specs(base, plan, "dp") if plan else base
+    if cfg is None or not cfg.vocab:
+        specs = {k: v for k, v in specs.items() if k != "emb"}
+    return specs
 
 
 def flagship_data_spec(mesh: Mesh) -> P:
@@ -234,6 +252,21 @@ def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
     k = jnp.einsum("btm,hmd->bhtd", x, sub_params["wk"])
     v = jnp.einsum("btm,hmd->bhtd", x, sub_params["wv"])
     sp_size = jax.lax.axis_size(sp) if sp is not None else 1
+    if cfg.rope:
+        from tpu_p2p.ops.attention import _block_positions
+        from tpu_p2p.ops.rope import apply_rope
+
+        t_loc = x.shape[1]
+        if sp is None or sp_size == 1:
+            positions = jnp.arange(t_loc)
+        else:
+            layout = ("zigzag" if cfg.sp_strategy == "ring_zigzag"
+                      else "contiguous")
+            positions = _block_positions(
+                jax.lax.axis_index(sp), sp_size, t_loc, layout
+            )
+        q = apply_rope(q, positions)
+        k = apply_rope(k, positions)
     if sp is not None and cfg.sp_strategy == "ulysses":
         from tpu_p2p.ops.ulysses import ulysses_attention_local
 
@@ -410,6 +443,11 @@ def place_flagship_params_pipelined(params: Params, mesh: Mesh,
     """
     from tpu_p2p.models.pipeline_interleaved import to_device_major
 
+    if cfg.vocab:
+        raise ValueError(
+            "vocab (the LM head) is unsupported with the 1F1B layout; "
+            "the emb leaf has no stage axis to permute"
+        )
     n = mesh.shape["pp"]
     s_chunk = cfg.stages // (n * chunks)
     specs = flagship_param_specs(mesh)
@@ -490,6 +528,11 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
             "GPipe train step (autodiff owns the ZeRO gather) or turn "
             "zero_dp off"
         )
+    if cfg.vocab:
+        raise ValueError(
+            "vocab (the LM head) is unsupported with the manual 1F1B "
+            "step; use make_flagship_lm_train_step (GPipe autodiff)"
+        )
     axes = _mesh_axes(mesh)
     if "pp" not in axes:
         raise ValueError("mesh needs a 'pp' axis for pipeline parallelism")
@@ -559,6 +602,108 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
         out_specs=(specs, P()),
     )
     return jax.jit(sm)
+
+
+def _lm_token_spec(mesh: Mesh) -> P:
+    """Token ids ``[B, T]``: batch over dp/ep, sequence over sp."""
+    dp, ep, sp = _axis(mesh, "dp"), _axis(mesh, "ep"), _axis(mesh, "sp")
+    batch_axes = tuple(a for a in (dp, ep) if a is not None)
+    return P(batch_axes if batch_axes else None, sp)
+
+
+def _lm_logits_local(params, tokens, cfg: FlagshipConfig, axes):
+    """Embed → transformer stack → tied unembed, per shard — the one
+    definition of the LM head, shared by the forward and the train
+    step so the reported loss can never diverge from the forward's
+    logits. Embedding and unembedding are position-independent, so
+    they sit outside the pipeline schedule (every pp rank computes
+    them on the replicated activations)."""
+    x = jnp.take(params["emb"], tokens, axis=0).astype(jnp.dtype(cfg.dtype))
+    y = _forward_local(params, x, cfg, axes)
+    return jnp.einsum("btm,vm->btv", y.astype(jnp.float32),
+                      params["emb"].astype(jnp.float32))
+
+
+def make_flagship_lm_forward(mesh: Mesh, cfg: FlagshipConfig):
+    """Jitted LM forward: global token ids ``[B, T]`` → logits
+    ``[B, T, vocab]``."""
+    from tpu_p2p.parallel import fsdp
+
+    if not cfg.vocab:
+        raise ValueError("cfg.vocab must be > 0 for the LM forward")
+    axes = _mesh_axes(mesh)
+    plan = _fsdp_plan(mesh, cfg)
+
+    def f(params, tokens):
+        if plan:
+            params = fsdp.all_gather_params(params, "dp", plan)
+        return _lm_logits_local(params, tokens, cfg, axes)
+
+    sm = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(flagship_param_specs(mesh, cfg), _lm_token_spec(mesh)),
+        out_specs=P(*tuple(_lm_token_spec(mesh)), None),
+    )
+    return jax.jit(sm)
+
+
+def make_flagship_lm_train_step(mesh: Mesh, cfg: FlagshipConfig,
+                                lr: float = 1e-2):
+    """One jitted SGD step on next-token cross-entropy.
+
+    ``(params, tokens [B, T], targets [B, T]) → (params, mean CE)``
+    (the caller shifts targets). Gradient reductions are implicit in
+    shard_map autodiff, exactly as in the regression step.
+    """
+    from tpu_p2p.parallel import fsdp
+
+    if not cfg.vocab:
+        raise ValueError("cfg.vocab must be > 0 for the LM step")
+    axes = _mesh_axes(mesh)
+    plan = _fsdp_plan(mesh, cfg)
+    specs = flagship_param_specs(mesh, cfg)
+    n_tok = cfg.batch * cfg.seq
+
+    def gstep(params, tokens, targets):
+        def local_loss(p):
+            pf = fsdp.all_gather_params(p, "dp", plan) if plan else p
+            logits = _lm_logits_local(pf, tokens, cfg, axes)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, targets[..., None],
+                                       axis=-1)[..., 0]
+            return jnp.sum(nll)
+
+        loss, grads = jax.value_and_grad(local_loss)(params)
+        data_axes = tuple(a for a in ("dp", "ep", "sp") if a in axes)
+        if data_axes:
+            loss = jax.lax.psum(loss, data_axes)
+        new_params = jax.tree.map(
+            lambda p, g: (p.astype(jnp.float32)
+                          - lr * g / n_tok).astype(p.dtype),
+            params, grads,
+        )
+        return new_params, loss / n_tok
+
+    tok_spec = _lm_token_spec(mesh)
+    sm = jax.shard_map(
+        gstep, mesh=mesh,
+        in_specs=(specs, tok_spec, tok_spec),
+        out_specs=(specs, P()),
+    )
+    return jax.jit(sm)
+
+
+def flagship_token_batch(cfg: FlagshipConfig, mesh: Mesh = None,
+                         seed: int = 1) -> Tuple:
+    """Random ``(tokens, next-token targets)`` int32 batches."""
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, cfg.vocab, (cfg.batch, cfg.seq + 1))
+    x = jnp.asarray(toks[:, :-1], jnp.int32)
+    t = jnp.asarray(toks[:, 1:], jnp.int32)
+    if mesh is not None:
+        sharding = NamedSharding(mesh, _lm_token_spec(mesh))
+        x, t = jax.device_put(x, sharding), jax.device_put(t, sharding)
+    return x, t
 
 
 def make_flagship_optax_step(mesh: Mesh, cfg: FlagshipConfig, tx):

@@ -1,0 +1,52 @@
+"""Rotary position embeddings (RoPE) — position encoding that survives
+sequence parallelism.
+
+The reference has no model code at all (its single source is the
+transport benchmark ``/root/reference/p2p_matrix.cc``); this module
+exists because a complete model stack needs positions, and RoPE is the
+encoding that composes cleanly with this framework's SP strategies:
+it is applied *per position, before* any KV movement, so a roped K
+block can rotate around the ring (or reshard through Ulysses
+all_to_alls, or sit zigzag-permuted) unchanged — each path only has to
+supply the right *global* position vector for its local shard, which
+the attention layer already tracks for causal masking.
+
+Convention: pairs are the two halves of the head dim (rotate_half, the
+GPT-NeoX/LLaMA layout); angles ``theta^(-2i/d)`` with the standard
+``theta = 10000``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_angles(positions, head_dim: int, theta: float = 10000.0):
+    """``(cos, sin)`` of shape ``[T, head_dim/2]`` for integer (or
+    traced) ``positions [T]``."""
+    if head_dim % 2:
+        raise ValueError(f"RoPE needs an even head dim, got {head_dim}")
+    inv_freq = theta ** (
+        -jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    )
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotate ``x [B, H, T, D]`` by its positions ``[T]``.
+
+    Elementwise per position — numerically in float32, returned in the
+    input dtype. Works for any head count, so GQA K tensors rope in
+    their narrow head count.
+    """
+    b, h, t, d = x.shape
+    cos, sin = rope_angles(positions, d, theta)
+    x1 = x[..., : d // 2].astype(jnp.float32)
+    x2 = x[..., d // 2:].astype(jnp.float32)
+    cos = cos[None, None]
+    sin = sin[None, None]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
