@@ -119,3 +119,65 @@ def test_lm_rejects_1f1b_layout():
         F.place_flagship_params_pipelined(
             F.init_flagship_params(cfg), mesh, cfg
         )
+
+
+def test_lm_decode_teacher_forced_matches_forward():
+    from tpu_p2p.models import decode as D
+
+    cfg = _cfg(microbatches=1)
+    mesh = _mesh(tp=2, dp=2)
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh, cfg)
+    toks, _ = F.flagship_token_batch(cfg, mesh)
+    want = np.asarray(F.make_flagship_lm_forward(mesh, cfg)(params, toks))
+    step = D.make_flagship_lm_decode_step(mesh, cfg)
+    cache = D.init_kv_cache(cfg, max_len=cfg.seq, mesh=mesh)
+    for t in range(cfg.seq):
+        cache, logits = step(params, cache, toks[:, t:t + 1], t)
+        np.testing.assert_allclose(np.asarray(logits)[:, 0, :],
+                                   want[:, t, :], atol=1e-4, rtol=1e-4,
+                                   err_msg=f"position {t}")
+
+
+def test_lm_greedy_generation_is_self_consistent():
+    from tpu_p2p.models import decode as D
+
+    cfg = _cfg(microbatches=1)
+    mesh = _mesh(ep=2)
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh, cfg)
+    toks, _ = F.flagship_token_batch(cfg, mesh)
+    prompt = toks[:, :4]
+    step = D.make_flagship_lm_decode_step(mesh, cfg)
+    cache = D.init_kv_cache(cfg, max_len=32, mesh=mesh)
+    cache, out = D.generate_tokens(step, params, cache, prompt, num_tokens=6)
+    assert out.shape == (cfg.batch, 10)
+    np.testing.assert_array_equal(np.asarray(out[:, :4]), np.asarray(prompt))
+    # Greedy self-consistency: teacher-forcing the generated sequence
+    # reproduces each generated token as the argmax at its position.
+    full = np.asarray(out)
+    cfg10 = _cfg(microbatches=1, seq=10, batch=cfg.batch)
+    logits = np.asarray(
+        F.make_flagship_lm_forward(mesh, cfg10)(
+            params, jax.device_put(
+                jnp.asarray(full, jnp.int32),
+                jax.sharding.NamedSharding(mesh, F._lm_token_spec(mesh)),
+            )
+        )
+    )
+    for t in range(4 - 1, 10 - 1):
+        np.testing.assert_array_equal(
+            np.argmax(logits[:, t, :], axis=-1), full[:, t + 1],
+            err_msg=f"position {t}",
+        )
+
+
+def test_generate_tokens_rejects_cache_overrun():
+    from tpu_p2p.models import decode as D
+
+    cfg = _cfg(microbatches=1)
+    mesh = _mesh()
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh, cfg)
+    step = D.make_flagship_lm_decode_step(mesh, cfg)
+    cache = D.init_kv_cache(cfg, max_len=8, mesh=mesh)
+    toks, _ = F.flagship_token_batch(cfg, mesh)
+    with pytest.raises(ValueError, match="overruns"):
+        D.generate_tokens(step, params, cache, toks[:, :4], num_tokens=8)

@@ -122,6 +122,35 @@ def _decode_sub_block(sub, x, k_cache, v_cache, pos, cfg, tp, ep):
     return x + m_out.reshape(x.shape)
 
 
+def _decode_stack(params, cache: Cache, x, pos, cfg, tp, ep):
+    """One token through every stage against the cache — the single
+    definition of the decode stack, shared by the continuous and
+    token-level steps. ``x``: ``[B_loc, 1, Dm]``. Returns
+    ``(cache, y)``.
+    """
+    k_all, v_all = cache["k"], cache["v"]
+    for s in range(cfg.stages):
+        # Stage-major leaves only: 'emb' (vocab configs) has a vocab
+        # leading dim, not a stage one.
+        sub = {kk: vv[s] for kk, vv in params.items() if kk != "emb"}
+        # Project and write this token's K/V at pos (time axis 2).
+        k_t = jnp.einsum("btm,hmd->bhtd", x, sub["wk"])
+        v_t = jnp.einsum("btm,hmd->bhtd", x, sub["wv"])
+        if cfg.rope:
+            # Cache stores roped K (standard): the new token's K is
+            # rotated by its position before the cache write, and
+            # this step's Q likewise inside the sub-block.
+            from tpu_p2p.ops.rope import apply_rope
+
+            k_t = apply_rope(k_t, jnp.reshape(pos, (1,)))
+        k_st = jax.lax.dynamic_update_slice_in_dim(k_all[s], k_t, pos, axis=2)
+        v_st = jax.lax.dynamic_update_slice_in_dim(v_all[s], v_t, pos, axis=2)
+        k_all = jax.lax.dynamic_update_index_in_dim(k_all, k_st, s, 0)
+        v_all = jax.lax.dynamic_update_index_in_dim(v_all, v_st, s, 0)
+        x = _decode_sub_block(sub, x, k_st, v_st, pos, cfg, tp, ep)
+    return {"k": k_all, "v": v_all}, x
+
+
 def make_flagship_decode_step(mesh: Mesh, cfg: FlagshipConfig):
     """Jitted ``(params, cache, x_t, pos) → (cache, y_t)``.
 
@@ -144,32 +173,7 @@ def make_flagship_decode_step(mesh: Mesh, cfg: FlagshipConfig):
     def step(params, cache, x_t, pos):
         if plan:
             params = fsdp.all_gather_params(params, "dp", plan)
-        k_all, v_all = cache["k"], cache["v"]
-        x = x_t
-        for s in range(cfg.stages):
-            # Stage-major leaves only: 'emb' (vocab configs) has a
-            # vocab leading dim, not a stage one.
-            sub = {kk: vv[s] for kk, vv in params.items() if kk != "emb"}
-            # Project and write this token's K/V at pos (time axis 2).
-            k_t = jnp.einsum("btm,hmd->bhtd", x, sub["wk"])
-            v_t = jnp.einsum("btm,hmd->bhtd", x, sub["wv"])
-            if cfg.rope:
-                # Cache stores roped K (standard): the new token's K is
-                # rotated by its position before the cache write, and
-                # this step's Q likewise inside the sub-block.
-                from tpu_p2p.ops.rope import apply_rope
-
-                k_t = apply_rope(k_t, jnp.reshape(pos, (1,)))
-            k_st = jax.lax.dynamic_update_slice_in_dim(
-                k_all[s], k_t, pos, axis=2
-            )
-            v_st = jax.lax.dynamic_update_slice_in_dim(
-                v_all[s], v_t, pos, axis=2
-            )
-            k_all = jax.lax.dynamic_update_index_in_dim(k_all, k_st, s, 0)
-            v_all = jax.lax.dynamic_update_index_in_dim(v_all, v_st, s, 0)
-            x = _decode_sub_block(sub, x, k_st, v_st, pos, cfg, tp, ep)
-        return {"k": k_all, "v": v_all}, x
+        return _decode_stack(params, cache, x_t, pos, cfg, tp, ep)
 
     # pp is forced to size 1 here, so the stage dim's P('pp') sharding
     # is byte-identical to replicated — but typed pp-varying it would
@@ -189,6 +193,108 @@ def make_flagship_decode_step(mesh: Mesh, cfg: FlagshipConfig):
     # direct step-by-step callers (generate's fused scan already does);
     # callers must treat the passed cache as consumed, as all tests do.
     return jax.jit(sm, donate_argnums=(1,))
+
+
+def make_flagship_lm_decode_step(mesh: Mesh, cfg: FlagshipConfig):
+    """Token-level decode: ``(params, cache, tokens [B, 1] int32, pos)
+    → (cache, logits [B, 1, vocab])``.
+
+    Wraps the continuous step's stack with the tied embedding on both
+    ends (one definition of the head lives in
+    :func:`tpu_p2p.models.flagship._lm_logits_local`; here the stack
+    runs cached, so embed/unembed are applied around the per-token
+    body directly).
+    """
+    from tpu_p2p.models.flagship import _mesh_axes
+    from tpu_p2p.parallel import fsdp
+
+    if not cfg.vocab:
+        raise ValueError("cfg.vocab must be > 0 for LM decoding")
+    _check_decode_mesh(mesh, cfg)
+    axes = _mesh_axes(mesh)
+    tp, ep = axes.get("tp"), axes.get("ep")
+    plan = _fsdp_plan(mesh, cfg)
+
+    dp_ax, ep_ax = _axis(mesh, "dp"), _axis(mesh, "ep")
+    batch_axes = tuple(a for a in (dp_ax, ep_ax) if a is not None)
+    tok_spec = P(batch_axes if batch_axes else None, None)
+    c_spec = cache_spec(mesh)
+
+    def step(params, cache, tokens, pos):
+        if plan:
+            params = fsdp.all_gather_params(params, "dp", plan)
+        x = jnp.take(params["emb"], tokens, axis=0).astype(
+            jnp.dtype(cfg.dtype)
+        )                                           # [B, 1, Dm]
+        cache, y = _decode_stack(params, cache, x, pos, cfg, tp, ep)
+        logits = jnp.einsum("btm,vm->btv", y.astype(jnp.float32),
+                            params["emb"].astype(jnp.float32))
+        return cache, logits
+
+    def strip_pp(spec: P) -> P:
+        return P(*[None if e == "pp" else e for e in tuple(spec)])
+
+    specs = {k: strip_pp(v)
+             for k, v in flagship_param_specs(mesh, cfg).items()}
+    sm = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(specs, {"k": c_spec, "v": c_spec}, tok_spec, P()),
+        out_specs=({"k": c_spec, "v": c_spec},
+                   P(*tuple(tok_spec), None)),
+    )
+    return jax.jit(sm, donate_argnums=(1,))
+
+
+def generate_tokens(step_fn, params, cache: Cache, prompt, *,
+                    num_tokens: int) -> Tuple[Cache, jax.Array]:
+    """Greedy LM rollout: consume the prompt ``[B, T0]`` token by
+    token (prefill scan), then argmax-sample ``num_tokens``
+    continuations (generation scan). Returns
+    ``(cache, tokens [B, T0 + num_tokens])``, one compiled program.
+    """
+    t0 = prompt.shape[1]
+    max_len = cache["k"].shape[3]
+    if t0 + num_tokens > max_len:
+        # dynamic_update_slice clamps, so overflowing the window would
+        # silently overwrite the last slot while the mask keeps it
+        # live — garbage tokens with no error. Fail loudly instead.
+        raise ValueError(
+            f"prompt ({t0}) + num_tokens ({num_tokens}) overruns the "
+            f"max_len={max_len} cache"
+        )
+
+    @jax.jit
+    def roll(params, cache, prompt):
+        def prefill(cache, i):
+            cache, logits = step_fn(
+                params, cache,
+                jax.lax.dynamic_slice_in_dim(prompt, i, 1, 1), i,
+            )
+            return cache, logits
+
+        cache, logits_seq = jax.lax.scan(
+            prefill, cache, jnp.arange(t0, dtype=jnp.int32)
+        )
+        first = jnp.argmax(
+            logits_seq[-1][:, 0, :], axis=-1
+        ).astype(jnp.int32)[:, None]
+
+        def gen(carry, i):
+            cache, tok = carry
+            cache, logits = step_fn(params, cache, tok, t0 + i)
+            nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(
+                jnp.int32
+            )[:, None]
+            # Emit the token fed this step: gen step i consumes
+            # generated token i and produces token i+1.
+            return (cache, nxt), tok[:, 0]
+
+        (cache, _), toks = jax.lax.scan(
+            gen, (cache, first), jnp.arange(num_tokens, dtype=jnp.int32)
+        )
+        return cache, jnp.concatenate([prompt, toks.T], axis=1)
+
+    return roll(params, cache, prompt)
 
 
 @functools.lru_cache(maxsize=32)  # bounded: each entry pins a compiled

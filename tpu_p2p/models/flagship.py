@@ -619,7 +619,10 @@ def _lm_logits_local(params, tokens, cfg: FlagshipConfig, axes):
     they sit outside the pipeline schedule (every pp rank computes
     them on the replicated activations)."""
     x = jnp.take(params["emb"], tokens, axis=0).astype(jnp.dtype(cfg.dtype))
-    y = _forward_local(params, x, cfg, axes)
+    # The stack sees only stage-major leaves: _stage_block slices every
+    # leaf by stage index, and emb's leading dim is the vocab.
+    stack = {k: v for k, v in params.items() if k != "emb"}
+    y = _forward_local(stack, x, cfg, axes)
     return jnp.einsum("btm,vm->btv", y.astype(jnp.float32),
                       params["emb"].astype(jnp.float32))
 
