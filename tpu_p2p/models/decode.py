@@ -65,6 +65,18 @@ def _check_decode_mesh(mesh: Mesh, cfg: FlagshipConfig) -> None:
             )
 
 
+def _decode_param_specs(mesh: Mesh, cfg) -> Dict[str, P]:
+    """Param specs with the pp stage sharding stripped: pp is forced to
+    size 1 in decode, so ``P('pp')`` on the stage dim is byte-identical
+    to replicated — but typed pp-varying it would poison the outputs'
+    replication inference."""
+    def strip_pp(spec: P) -> P:
+        return P(*[None if e == "pp" else e for e in tuple(spec)])
+
+    return {k: strip_pp(v)
+            for k, v in flagship_param_specs(mesh, cfg).items()}
+
+
 def cache_spec(mesh: Mesh) -> P:
     """``[stages, B, H_kv, max_len, Dh]``: batch over dp/ep, KV heads
     over tp."""
@@ -175,14 +187,7 @@ def make_flagship_decode_step(mesh: Mesh, cfg: FlagshipConfig):
             params = fsdp.all_gather_params(params, "dp", plan)
         return _decode_stack(params, cache, x_t, pos, cfg, tp, ep)
 
-    # pp is forced to size 1 here, so the stage dim's P('pp') sharding
-    # is byte-identical to replicated — but typed pp-varying it would
-    # poison the outputs' replication inference. Strip it.
-    def strip_pp(spec: P) -> P:
-        return P(*[None if e == "pp" else e for e in tuple(spec)])
-
-    specs = {k: strip_pp(v)
-             for k, v in flagship_param_specs(mesh, cfg).items()}
+    specs = _decode_param_specs(mesh, cfg)
     cache_specs = {"k": c_spec, "v": c_spec}
     sm = jax.shard_map(
         step, mesh=mesh,
@@ -231,11 +236,7 @@ def make_flagship_lm_decode_step(mesh: Mesh, cfg: FlagshipConfig):
                             params["emb"].astype(jnp.float32))
         return cache, logits
 
-    def strip_pp(spec: P) -> P:
-        return P(*[None if e == "pp" else e for e in tuple(spec)])
-
-    specs = {k: strip_pp(v)
-             for k, v in flagship_param_specs(mesh, cfg).items()}
+    specs = _decode_param_specs(mesh, cfg)
     sm = jax.shard_map(
         step, mesh=mesh,
         in_specs=(specs, {"k": c_spec, "v": c_spec}, tok_spec, P()),

@@ -154,6 +154,21 @@ def _axis(mesh: Mesh, name: str):
     return name if name in mesh.axis_names else None
 
 
+def _data_axes(axes: Dict[str, str]) -> Tuple[str, ...]:
+    """The axes data (and thus loss/grad partial sums) shard over."""
+    return tuple(a for a in ("dp", "ep", "sp") if a in axes)
+
+
+def _sgd_update(params: Params, grads, lr: float, denom: float):
+    """`p - lr*g/denom` elementwise in f32, cast back to each param's
+    dtype — the one SGD update shared by every train-step flavor."""
+    return jax.tree.map(
+        lambda p, g: (p.astype(jnp.float32)
+                      - lr * g / denom).astype(p.dtype),
+        params, grads,
+    )
+
+
 def flagship_param_shapes(cfg: FlagshipConfig) -> Dict[str, Tuple[int, ...]]:
     """Parameter shapes from the config alone (no initialization) —
     feeds the static FSDP plan and checkpoint metadata."""
@@ -252,6 +267,7 @@ def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
     k = jnp.einsum("btm,hmd->bhtd", x, sub_params["wk"])
     v = jnp.einsum("btm,hmd->bhtd", x, sub_params["wv"])
     sp_size = jax.lax.axis_size(sp) if sp is not None else 1
+    layout = "zigzag" if cfg.sp_strategy == "ring_zigzag" else "contiguous"
     if cfg.rope:
         from tpu_p2p.ops.attention import _block_positions
         from tpu_p2p.ops.rope import apply_rope
@@ -260,8 +276,6 @@ def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
         if sp is None or sp_size == 1:
             positions = jnp.arange(t_loc)
         else:
-            layout = ("zigzag" if cfg.sp_strategy == "ring_zigzag"
-                      else "contiguous")
             positions = _block_positions(
                 jax.lax.axis_index(sp), sp_size, t_loc, layout
             )
@@ -278,8 +292,6 @@ def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
                 "use_flash requires sp_strategy='ulysses' (or sp size 1): "
                 "the ring path's streaming flash kernel is forward-only"
             )
-        layout = ("zigzag" if cfg.sp_strategy == "ring_zigzag"
-                  else "contiguous")
         a = ring_attention_local(q, k, v, sp, causal=cfg.causal,
                                  layout=layout)
     elif cfg.use_flash:  # size-1 sp (or no sp axis): sequence is local
@@ -326,9 +338,7 @@ def _pipeline_schedule(stage_params, x_mb, cfg, s_local, pp, sp, tp, ep):
 def _forward_local(params, x, cfg: FlagshipConfig, mesh_axes):
     dp, pp, sp, tp, ep = (mesh_axes.get(a) for a in AXES)
     del dp
-    pp_size = 1
-    if pp is not None:
-        pp_size = jax.lax.axis_size(pp)
+    pp_size = jax.lax.axis_size(pp) if pp is not None else 1
     if cfg.stages % pp_size:
         raise ValueError(
             f"stages ({cfg.stages}) must divide by pp size ({pp_size})"
@@ -400,7 +410,7 @@ def make_flagship_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
         loss, grads = jax.value_and_grad(local_loss)(params)
         # Sum the partial losses over every data-sharded axis; pp/tp
         # replicas are typed replicated and count once.
-        data_axes = tuple(a for a in ("dp", "ep", "sp") if a in axes)
+        data_axes = _data_axes(axes)
         if data_axes:
             loss = jax.lax.psum(loss, data_axes)
         return grads, loss
@@ -422,12 +432,7 @@ def make_flagship_train_step(mesh: Mesh, cfg: FlagshipConfig,
     @jax.jit
     def step(params, x, target):
         grads, loss = grad_fn(params, x, target)
-        new_params = jax.tree.map(
-            lambda p, g: (p.astype(jnp.float32)
-                          - lr * g / n_out).astype(p.dtype),
-            params, grads,
-        )
-        return new_params, loss / n_out
+        return _sgd_update(params, grads, lr, n_out), loss / n_out
 
     return step
 
@@ -506,12 +511,13 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
     ``jax.vjp`` with rematerialized forwards, O(S)-bounded activation
     stash) whose stage block runs the full transformer sub-block —
     ring/Ulysses sp attention, Megatron tp ``psum``, MoE ep
-    ``all_to_all`` — inside the vjp. Because backprop is manual, the
-    gradient reductions shard_map autodiff normally inserts are applied
-    explicitly: each param's grads are ``psum``-ed over every mesh axis
-    its sharding spec does not cover (dp/sp always; tp for the router;
-    dp/sp/tp for nothing-sharded leaves), and the loss over the data
-    axes. Params use the device-major chunk layout
+    ``all_to_all`` — inside the vjp. Gradient accounting under manual
+    backprop: ``jax.vjp`` *inside* shard_map already inserts the
+    cross-shard psum for any axis the primal doesn't vary over (the
+    per-tick dchunk arrives fully summed over dp/ep/sp and tp-joined),
+    so only the loss needs an explicit data-axis psum — and each
+    gradient accumulator is typed by its param's own sharded axes.
+    Params use the device-major chunk layout
     (:func:`place_flagship_params_pipelined`); ``chunks > 1`` gives the
     interleaved virtual-stage schedule. ``zero_dp`` is unsupported here
     (ZeRO's gather-on-use transpose needs autodiff owning the params).
@@ -551,7 +557,7 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
     def block_fn(chunk_params, x):
         return _stage_block(chunk_params, x, cfg, s_chunk, sp, tp, ep)
 
-    data_axes = tuple(a for a in ("dp", "ep", "sp") if a in axes)
+    data_axes = _data_axes(axes)
 
     def spec_axes(spec: P) -> set:
         named = set()
@@ -589,12 +595,7 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
         )
         if data_axes:
             loss_sum = jax.lax.psum(loss_sum, data_axes)
-        new_params = jax.tree.map(
-            lambda p, g: (p.astype(jnp.float32)
-                          - lr * g / n_out).astype(p.dtype),
-            params, grads,
-        )
-        return new_params, loss_sum / n_out
+        return _sgd_update(params, grads, lr, n_out), loss_sum / n_out
 
     sm = jax.shard_map(
         step, mesh=mesh,
@@ -642,10 +643,11 @@ def make_flagship_lm_forward(mesh: Mesh, cfg: FlagshipConfig):
             params = fsdp.all_gather_params(params, "dp", plan)
         return _lm_logits_local(params, tokens, cfg, axes)
 
+    tok_spec = _lm_token_spec(mesh)
     sm = jax.shard_map(
         f, mesh=mesh,
-        in_specs=(flagship_param_specs(mesh, cfg), _lm_token_spec(mesh)),
-        out_specs=P(*tuple(_lm_token_spec(mesh)), None),
+        in_specs=(flagship_param_specs(mesh, cfg), tok_spec),
+        out_specs=P(*tuple(tok_spec), None),
     )
     return jax.jit(sm)
 
@@ -677,15 +679,10 @@ def make_flagship_lm_train_step(mesh: Mesh, cfg: FlagshipConfig,
             return jnp.sum(nll)
 
         loss, grads = jax.value_and_grad(local_loss)(params)
-        data_axes = tuple(a for a in ("dp", "ep", "sp") if a in axes)
+        data_axes = _data_axes(axes)
         if data_axes:
             loss = jax.lax.psum(loss, data_axes)
-        new_params = jax.tree.map(
-            lambda p, g: (p.astype(jnp.float32)
-                          - lr * g / n_tok).astype(p.dtype),
-            params, grads,
-        )
-        return new_params, loss / n_tok
+        return _sgd_update(params, grads, lr, n_tok), loss / n_tok
 
     tok_spec = _lm_token_spec(mesh)
     sm = jax.shard_map(
