@@ -181,3 +181,63 @@ def test_generate_tokens_rejects_cache_overrun():
     toks, _ = F.flagship_token_batch(cfg, mesh)
     with pytest.raises(ValueError, match="overruns"):
         D.generate_tokens(step, params, cache, toks[:, :4], num_tokens=8)
+
+
+def test_sampled_generation_respects_top_k_support():
+    from tpu_p2p.models import decode as D
+
+    cfg = _cfg(microbatches=1)
+    mesh = _mesh()
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh, cfg)
+    step = D.make_flagship_lm_decode_step(mesh, cfg)
+    toks, _ = F.flagship_token_batch(cfg, mesh)
+    prompt = toks[:, :4]
+
+    # temperature=0 must reproduce greedy exactly.
+    cache_a = D.init_kv_cache(cfg, max_len=16, mesh=mesh)
+    _, greedy = D.generate_tokens(step, params, cache_a, prompt,
+                                  num_tokens=6)
+    cache_b = D.init_kv_cache(cfg, max_len=16, mesh=mesh)
+    _, zero_t = D.generate_tokens(step, params, cache_b, prompt,
+                                  num_tokens=6, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(zero_t))
+
+    # top_k=1 sampling == greedy regardless of temperature/key.
+    cache_c = D.init_kv_cache(cfg, max_len=16, mesh=mesh)
+    _, k1 = D.generate_tokens(step, params, cache_c, prompt, num_tokens=6,
+                              temperature=2.0, top_k=1,
+                              rng=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+
+    # Hot sampling with a wide top_k diverges from greedy and stays
+    # inside the vocab; two keys give two different rollouts.
+    cache_d = D.init_kv_cache(cfg, max_len=16, mesh=mesh)
+    _, hot1 = D.generate_tokens(step, params, cache_d, prompt, num_tokens=6,
+                                temperature=5.0, rng=jax.random.PRNGKey(1))
+    cache_e = D.init_kv_cache(cfg, max_len=16, mesh=mesh)
+    _, hot2 = D.generate_tokens(step, params, cache_e, prompt, num_tokens=6,
+                                temperature=5.0, rng=jax.random.PRNGKey(2))
+    assert (np.asarray(hot1) != np.asarray(hot2)).any()
+    assert (np.asarray(hot1)[:, 4:] < cfg.vocab).all()
+
+    with pytest.raises(ValueError, match="rng"):
+        D.generate_tokens(step, params, cache_e, prompt, num_tokens=2,
+                          temperature=1.0)
+
+
+def test_sampling_arg_validation():
+    from tpu_p2p.models import decode as D
+
+    cfg = _cfg(microbatches=1)
+    mesh = _mesh()
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh, cfg)
+    step = D.make_flagship_lm_decode_step(mesh, cfg)
+    cache = D.init_kv_cache(cfg, max_len=16, mesh=mesh)
+    toks, _ = F.flagship_token_batch(cfg, mesh)
+    prompt = toks[:, :4]
+    with pytest.raises(ValueError, match="no effect"):
+        D.generate_tokens(step, params, cache, prompt, num_tokens=2,
+                          top_k=10)
+    with pytest.raises(ValueError, match=">= 0"):
+        D.generate_tokens(step, params, cache, prompt, num_tokens=2,
+                          temperature=-1.0, rng=jax.random.PRNGKey(0))

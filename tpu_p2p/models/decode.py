@@ -28,7 +28,7 @@ train/infer story). TPU-first mechanics:
 from __future__ import annotations
 
 import functools
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -247,13 +247,31 @@ def make_flagship_lm_decode_step(mesh: Mesh, cfg: FlagshipConfig):
 
 
 def generate_tokens(step_fn, params, cache: Cache, prompt, *,
-                    num_tokens: int) -> Tuple[Cache, jax.Array]:
-    """Greedy LM rollout: consume the prompt ``[B, T0]`` token by
-    token (prefill scan), then argmax-sample ``num_tokens``
-    continuations (generation scan). Returns
-    ``(cache, tokens [B, T0 + num_tokens])``, one compiled program.
+                    num_tokens: int, temperature: float = 0.0,
+                    top_k: int = 0,
+                    rng: Optional[jax.Array] = None) -> Tuple[Cache, jax.Array]:
+    """LM rollout: consume the prompt ``[B, T0]`` token by token
+    (prefill scan), then sample ``num_tokens`` continuations
+    (generation scan). Returns ``(cache, tokens [B, T0 + num_tokens])``,
+    one compiled program.
+
+    Sampling: ``temperature == 0`` (default) is greedy argmax;
+    otherwise logits are divided by ``temperature`` and sampled
+    categorically (``rng`` required), restricted to the ``top_k``
+    highest-probability tokens when ``top_k > 0``.
     """
     t0 = prompt.shape[1]
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    if temperature == 0 and (top_k > 0 or rng is not None):
+        # Mirror the check above: top_k/rng with greedy decoding means
+        # the caller forgot temperature= and would silently get argmax.
+        raise ValueError(
+            "top_k/rng have no effect at temperature=0 (greedy); pass "
+            "temperature>0 to sample"
+        )
     max_len = cache["k"].shape[3]
     if t0 + num_tokens > max_len:
         # dynamic_update_slice clamps, so overflowing the window would
@@ -273,25 +291,36 @@ def generate_tokens(step_fn, params, cache: Cache, prompt, *,
             )
             return cache, logits
 
+        def pick(logits, key):
+            z = logits[:, 0, :]
+            if temperature <= 0:
+                return jnp.argmax(z, axis=-1).astype(jnp.int32)[:, None]
+            z = z / temperature
+            if top_k > 0:
+                kth = jax.lax.top_k(z, top_k)[0][:, -1:]
+                z = jnp.where(z >= kth, z, -jnp.inf)
+            return jax.random.categorical(key, z, axis=-1).astype(
+                jnp.int32
+            )[:, None]
+
         cache, logits_seq = jax.lax.scan(
             prefill, cache, jnp.arange(t0, dtype=jnp.int32)
         )
-        first = jnp.argmax(
-            logits_seq[-1][:, 0, :], axis=-1
-        ).astype(jnp.int32)[:, None]
+        key0 = rng if rng is not None else jax.random.PRNGKey(0)
+        keys = jax.random.split(key0, num_tokens + 1)
+        first = pick(logits_seq[-1], keys[0])
 
-        def gen(carry, i):
+        def gen(carry, inputs):
             cache, tok = carry
+            i, key = inputs
             cache, logits = step_fn(params, cache, tok, t0 + i)
-            nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(
-                jnp.int32
-            )[:, None]
             # Emit the token fed this step: gen step i consumes
             # generated token i and produces token i+1.
-            return (cache, nxt), tok[:, 0]
+            return (cache, pick(logits, key)), tok[:, 0]
 
         (cache, _), toks = jax.lax.scan(
-            gen, (cache, first), jnp.arange(num_tokens, dtype=jnp.int32)
+            gen, (cache, first),
+            (jnp.arange(num_tokens, dtype=jnp.int32), keys[1:]),
         )
         return cache, jnp.concatenate([prompt, toks.T], axis=1)
 
