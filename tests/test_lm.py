@@ -241,3 +241,18 @@ def test_sampling_arg_validation():
     with pytest.raises(ValueError, match=">= 0"):
         D.generate_tokens(step, params, cache, prompt, num_tokens=2,
                           temperature=-1.0, rng=jax.random.PRNGKey(0))
+
+
+def test_negative_top_k_rejected():
+    from tpu_p2p.models import decode as D
+
+    cfg = _cfg(microbatches=1)
+    mesh = _mesh()
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh, cfg)
+    step = D.make_flagship_lm_decode_step(mesh, cfg)
+    cache = D.init_kv_cache(cfg, max_len=16, mesh=mesh)
+    toks, _ = F.flagship_token_batch(cfg, mesh)
+    with pytest.raises(ValueError, match="top_k"):
+        D.generate_tokens(step, params, cache, toks[:, :4], num_tokens=2,
+                          temperature=1.0, top_k=-5,
+                          rng=jax.random.PRNGKey(0))

@@ -272,6 +272,8 @@ def generate_tokens(step_fn, params, cache: Cache, prompt, *,
             "top_k/rng have no effect at temperature=0 (greedy); pass "
             "temperature>0 to sample"
         )
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
     max_len = cache["k"].shape[3]
     if t0 + num_tokens > max_len:
         # dynamic_update_slice clamps, so overflowing the window would

@@ -53,11 +53,14 @@ def repeat_kv(x, h_q: int):
     return jnp.repeat(x, h_q // h_kv, axis=1)
 
 
-def dense_attention(q, k, v, *, causal: bool = False):
+def dense_attention(q, k, v, *, causal: bool = False,
+                    window: Optional[int] = None):
     """Reference single-device attention (test oracle).
 
     Accepts GQA/MQA inputs: ``k``/``v`` may carry fewer heads than
-    ``q`` (``q.shape[1] % k.shape[1] == 0``).
+    ``q`` (``q.shape[1] % k.shape[1] == 0``). ``window`` restricts a
+    causal mask to the last ``window`` positions (sliding-window /
+    local attention).
     """
     k = repeat_kv(k, q.shape[1])
     v = repeat_kv(v, q.shape[1])
@@ -66,6 +69,8 @@ def dense_attention(q, k, v, *, causal: bool = False):
     s = s / math.sqrt(d)
     if causal:
         mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+        if window is not None:
+            mask &= ~jnp.tril(jnp.ones((t, t), dtype=bool), -window)
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,

@@ -68,7 +68,8 @@ def _default_blocks(tq: int, tk: int, d: int) -> Tuple[int, int]:
 
 
 def _kernel(offs_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
-            o_ref, m_ref, l_ref, *, block_k: int, causal: bool, scale: float):
+            o_ref, m_ref, l_ref, *, block_k: int, causal: bool,
+            window, band, scale: float):
     """Grid cell = (batch*head, q block, KV block).
 
     The KV block index is the *innermost grid dimension*, not an
@@ -86,6 +87,11 @@ def _kernel(offs_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
     kb = pl.program_id(2)
     j = pl.program_id(1)
     bq = q_ref.shape[1]
+    # Banded sweep (sliding window): the grid's k dim covers only the
+    # `band` tiles that can intersect this q tile's window, and the
+    # BlockSpec index map slides the fetched tile with j — kt is the
+    # *actual* k tile index the fetched data came from.
+    kt = kb if band is None else j * bq // block_k - (band - 1) + kb
 
     @pl.when(kb == 0)
     def _seed():
@@ -97,8 +103,15 @@ def _kernel(offs_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
     if causal:
         # Skip KV tiles that are entirely in this q block's future:
         # first key position in the tile vs last query position.
-        block_live = (offs_ref[1] + kb * block_k
+        block_live = (offs_ref[1] + kt * block_k
                       <= offs_ref[0] + (j + 1) * bq - 1)
+        if band is not None:
+            block_live &= kt >= 0  # band slid past the sequence start
+        if window is not None:
+            # ...and tiles entirely behind the sliding window: last key
+            # position vs the first query's window start.
+            block_live &= (offs_ref[1] + (kt + 1) * block_k - 1
+                           >= offs_ref[0] + j * bq - (window - 1))
     else:
         block_live = True
 
@@ -121,10 +134,12 @@ def _kernel(offs_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
         ) * scale                      # (bq, bk)
         visible = None
         if causal:
-            k_pos = offs_ref[1] + kb * block_k + jax.lax.broadcasted_iota(
+            k_pos = offs_ref[1] + kt * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1
             )
             visible = q_pos >= k_pos   # (bq, bk)
+            if window is not None:
+                visible &= q_pos - k_pos < window
             s = jnp.where(visible, s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)     # (bq, 1)
@@ -195,14 +210,17 @@ def _expand_kv_rows(k3, bh: int, q_heads: int):
     return wide.reshape(bh, tk, d)
 
 
-def _causal_mask(tq, tk, q_off, k_off):
+def _causal_mask(tq, tk, q_off, k_off, window=None):
     q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
     k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
-    return q_pos >= k_pos
+    vis = q_pos >= k_pos
+    if window is not None:
+        vis &= q_pos - k_pos < window
+    return vis
 
 
 def _flash_call_jax(q3, k3, v3, o0, m0, l0, q_off, k_off, *,
-                    causal: bool, q_heads: int):
+                    causal: bool, window, q_heads: int):
     """Plain-jax accumulate pass with the kernel's exact math — used
     when ``interpret`` is on *and* operands carry varying-mesh-axes
     typing: pallas's HLO interpreter evaluates the kernel jaxpr inline,
@@ -220,7 +238,7 @@ def _flash_call_jax(q3, k3, v3, o0, m0, l0, q_off, k_off, *,
         preferred_element_type=jnp.float32,
     ) * scale                            # (bh, tq, tk)
     if causal:
-        visible = _causal_mask(tq, k3.shape[1], q_off, k_off)
+        visible = _causal_mask(tq, k3.shape[1], q_off, k_off, window)
         s = jnp.where(visible, s, NEG_INF)
     m_new = jnp.maximum(m0, s.max(axis=-1))
     alpha = jnp.exp(m0 - m_new)
@@ -246,11 +264,12 @@ def _vma_of(*arrays) -> frozenset:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_k", "q_heads", "interpret"),
+    static_argnames=("causal", "window", "block_q", "block_k", "q_heads",
+                     "interpret", "band_ok"),
 )
 def _flash_call(q3, k3, v3, o0, m0, l0, q_off, k_off, *,
                 causal: bool, block_q: int, block_k: int, q_heads: int,
-                interpret: bool):
+                interpret: bool, window=None, band_ok: bool = False):
     """One accumulate pass of q3 against the whole of k3/v3.
 
     Shapes: ``q3 [B·H_q, Tq, D]``, ``k3/v3 [B·H_kv, Tk, D]``, carry
@@ -261,7 +280,8 @@ def _flash_call(q3, k3, v3, o0, m0, l0, q_off, k_off, *,
     """
     if interpret and _vma_of(q3, k3, v3, o0, m0, l0):
         return _flash_call_jax(q3, k3, v3, o0, m0, l0, q_off, k_off,
-                               causal=causal, q_heads=q_heads)
+                               causal=causal, window=window,
+                               q_heads=q_heads)
     bh, tq, d = q3.shape
     tk = k3.shape[1]
     group = _gqa_group(bh, k3.shape[0], q_heads)
@@ -276,16 +296,35 @@ def _flash_call(q3, k3, v3, o0, m0, l0, q_off, k_off, *,
 
     # KV tiles ride the innermost grid dim; q and the o/m/l blocks use
     # index maps independent of kb, so they stay VMEM-resident across
-    # the KV sweep (see _kernel docstring).
+    # the KV sweep (see _kernel docstring). With a sliding window the
+    # k dim covers only the `band` tiles that can intersect a q tile's
+    # window — the index map slides the fetched tile with j, so dead
+    # tiles are never DMA'd (this, not the compute skip, is where the
+    # O(T·window) cost comes from; fetching the full sweep measured
+    # only 1.5x at T=16k/W=1024 where banding gives the full ratio).
+    band = None
+    if window is not None and causal and block_q == block_k and band_ok:
+        # The band arithmetic relies on equal block sizes AND zero
+        # q/k offsets (kv_map has no offset term; offsets are tracers
+        # here, so the zero guarantee must come from the caller via
+        # band_ok — _flash_fwd always passes offsets 0). Other callers
+        # fall back to the full sweep with per-tile compute skipping
+        # (correct, just less saved).
+        band = min(tk // block_k, -(-(window - 1) // block_k) + 1)
+
+    def kv_map(i, j, kb, s):
+        if band is None:
+            return (kvrow(i), kb, 0)
+        kt = j * block_q // block_k - (band - 1) + kb
+        return (kvrow(i), jax.lax.max(kt, 0), 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(bh, tq // block_q, tk // block_k),
+        grid=(bh, tq // block_q, band if band is not None else tk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kb, s: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda i, j, kb, s: (kvrow(i), kb, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda i, j, kb, s: (kvrow(i), kb, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
             pl.BlockSpec((1, block_q, d), lambda i, j, kb, s: (i, j, 0)),
             pl.BlockSpec((1, block_q, 1), lambda i, j, kb, s: (i, j, 0)),
             pl.BlockSpec((1, block_q, 1), lambda i, j, kb, s: (i, j, 0)),
@@ -306,7 +345,8 @@ def _flash_call(q3, k3, v3, o0, m0, l0, q_off, k_off, *,
         offs, q3, k3, v3, o0, m0, l0
     )
     kernel = functools.partial(
-        _kernel, block_k=block_k, causal=causal, scale=scale,
+        _kernel, block_k=block_k, causal=causal, window=window, band=band,
+        scale=scale,
     )
     o, m, l = pl.pallas_call(
         kernel,
@@ -379,7 +419,8 @@ def flash_carry_block(q, k, v, o, m, l, q_off, k_off, *,
 _bwd_blocks = _default_blocks
 
 
-def _recompute_p(q, kblk, Lr, offs_ref, q_idx, k_idx, bq, bk, causal, scale):
+def _recompute_p(q, kblk, Lr, offs_ref, q_idx, k_idx, bq, bk, causal,
+                 window, scale):
     """Rebuild the probability tile ``P = exp(S·scale − L)`` from the
     saved logsumexp — shared by both backward kernels.
 
@@ -398,19 +439,27 @@ def _recompute_p(q, kblk, Lr, offs_ref, q_idx, k_idx, bq, bk, causal, scale):
         k_pos = offs_ref[1] + k_idx * bk + jax.lax.broadcasted_iota(
             jnp.int32, (1, bk), 1
         )
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        vis = q_pos >= k_pos
+        if window is not None:
+            vis &= q_pos - k_pos < window
+        s = jnp.where(vis, s, NEG_INF)
     return jnp.exp(s - Lr)
 
 
 def _bwd_dkdv_kernel(offs_ref, q_ref, do_ref, L_ref, dl_ref, k_ref, v_ref,
-                     dk_ref, dv_ref, *, causal: bool, scale: float):
+                     dk_ref, dv_ref, *, causal: bool, window, band,
+                     n_q_tiles, scale: float):
     """Grid cell = (batch*head, KV block, q block) — q innermost, so the
     f32 dk/dv output tiles stay VMEM-resident across the whole q sweep
-    (same revisiting trick as the forward's o/m/l)."""
+    (same revisiting trick as the forward's o/m/l). ``band``: windowed
+    sweeps cover only the q tiles inside [k, k + window) — ``qt`` is
+    the actual q tile index; liveness also caps it at ``n_q_tiles``
+    (the band slides past the sequence end near the last KV tiles)."""
     qi = pl.program_id(2)
     kb = pl.program_id(1)
     bq = q_ref.shape[1]
     bk = k_ref.shape[1]
+    qt = qi if band is None else kb * bk // bq + qi
 
     @pl.when(qi == 0)
     def _seed():
@@ -420,8 +469,15 @@ def _bwd_dkdv_kernel(offs_ref, q_ref, do_ref, L_ref, dl_ref, k_ref, v_ref,
     if causal:
         # Skip q tiles entirely before this KV tile: contribution exists
         # only when the tile's last query >= the tile's first key.
-        block_live = (offs_ref[0] + (qi + 1) * bq - 1
+        block_live = (offs_ref[0] + (qt + 1) * bq - 1
                       >= offs_ref[1] + kb * bk)
+        if band is not None:
+            block_live &= qt < n_q_tiles
+        if window is not None:
+            # ...and q tiles entirely past the window of this KV tile's
+            # last key: first query vs last key + window.
+            block_live &= (offs_ref[0] + qt * bq
+                           <= offs_ref[1] + (kb + 1) * bk - 1 + window - 1)
     else:
         block_live = True
 
@@ -431,8 +487,8 @@ def _bwd_dkdv_kernel(offs_ref, q_ref, do_ref, L_ref, dl_ref, k_ref, v_ref,
         do = do_ref[0]                 # (bq, D)
         kblk = k_ref[0]                # (bk, D)
         vblk = v_ref[0]
-        p = _recompute_p(q, kblk, L_ref[0], offs_ref, qi, kb, bq, bk,
-                         causal, scale)
+        p = _recompute_p(q, kblk, L_ref[0], offs_ref, qt, kb, bq, bk,
+                         causal, window, scale)
         # dV += Pᵀ·dO — P cast to the value dtype for the MXU, f32 acc.
         dv_ref[0] += jax.lax.dot_general(
             p.astype(vblk.dtype), do, (((0,), (0,)), ((), ())),
@@ -450,21 +506,28 @@ def _bwd_dkdv_kernel(offs_ref, q_ref, do_ref, L_ref, dl_ref, k_ref, v_ref,
 
 
 def _bwd_dq_kernel(offs_ref, k_ref, v_ref, do_ref, L_ref, dl_ref, q_ref,
-                   dq_ref, *, causal: bool, scale: float):
+                   dq_ref, *, causal: bool, window, band, scale: float):
     """Grid cell = (batch*head, q block, KV block) — KV innermost; the
-    f32 dq tile stays resident across the KV sweep."""
+    f32 dq tile stays resident across the KV sweep. ``band``: windowed
+    sweeps cover only the in-band KV tiles (see _kernel)."""
     kb = pl.program_id(2)
     j = pl.program_id(1)
     bq = q_ref.shape[1]
     bk = k_ref.shape[1]
+    kt = kb if band is None else j * bq // bk - (band - 1) + kb
 
     @pl.when(kb == 0)
     def _seed():
         dq_ref[0] = jnp.zeros_like(dq_ref[0])
 
     if causal:
-        block_live = (offs_ref[1] + kb * bk
+        block_live = (offs_ref[1] + kt * bk
                       <= offs_ref[0] + (j + 1) * bq - 1)
+        if band is not None:
+            block_live &= kt >= 0
+        if window is not None:
+            block_live &= (offs_ref[1] + (kt + 1) * bk - 1
+                           >= offs_ref[0] + j * bq - (window - 1))
     else:
         block_live = True
 
@@ -474,8 +537,8 @@ def _bwd_dq_kernel(offs_ref, k_ref, v_ref, do_ref, L_ref, dl_ref, q_ref,
         do = do_ref[0]
         kblk = k_ref[0]
         vblk = v_ref[0]
-        p = _recompute_p(q, kblk, L_ref[0], offs_ref, j, kb, bq, bk,
-                         causal, scale)
+        p = _recompute_p(q, kblk, L_ref[0], offs_ref, j, kt, bq, bk,
+                         causal, window, scale)
         dp = jax.lax.dot_general(
             do, vblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -488,7 +551,7 @@ def _bwd_dq_kernel(offs_ref, k_ref, v_ref, do_ref, L_ref, dl_ref, q_ref,
 
 
 def _flash_bwd_jax(q3, k3, v3, do3, L, delta, q_off, k_off, *,
-                   causal: bool, q_heads: int):
+                   causal: bool, window, q_heads: int):
     """Plain-jax FlashAttention-2 backward (see :func:`_flash_call_jax`
     for when this path runs). Matches the kernels' contract: dk/dv come
     back per *query* head (``B·H_q`` rows); the caller folds GQA groups.
@@ -502,7 +565,8 @@ def _flash_bwd_jax(q3, k3, v3, do3, L, delta, q_off, k_off, *,
         preferred_element_type=jnp.float32,
     ) * scale
     if causal:
-        s = jnp.where(_causal_mask(tq, ke.shape[1], q_off, k_off), s, NEG_INF)
+        s = jnp.where(_causal_mask(tq, ke.shape[1], q_off, k_off, window),
+                      s, NEG_INF)
     p = jnp.exp(s - L[..., None])  # fully-masked rows: L == +1e30 → 0
     dp = jax.lax.dot_general(
         do3.astype(jnp.float32), ve.astype(jnp.float32),
@@ -527,11 +591,12 @@ def _flash_bwd_jax(q3, k3, v3, do3, L, delta, q_off, k_off, *,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_k", "q_heads", "interpret"),
+    static_argnames=("causal", "window", "block_q", "block_k", "q_heads",
+                     "interpret", "band_ok"),
 )
 def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
                     causal: bool, block_q: int, block_k: int, q_heads: int,
-                    interpret: bool):
+                    interpret: bool, window=None, band_ok: bool = False):
     """dq/dk/dv (f32) for one attention block, FlashAttention-2 style.
 
     ``L [bh, Tq]`` is the forward's logsumexp, ``delta [bh, Tq]`` the
@@ -543,7 +608,8 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
     """
     if interpret and _vma_of(q3, k3, v3, do3, L, delta):
         return _flash_bwd_jax(q3, k3, v3, do3, L, delta, q_off, k_off,
-                               causal=causal, q_heads=q_heads)
+                               causal=causal, window=window,
+                               q_heads=q_heads)
     bh, tq, d = q3.shape
     tk = k3.shape[1]
     group = _gqa_group(bh, k3.shape[0], q_heads)
@@ -560,21 +626,39 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
     # Both kernels share block shapes but differ in which middle grid
     # slot indexes q vs KV; qmap(first/second) picks per call, and an
     # optional row map sends the leading grid index through the GQA
-    # narrow-KV mapping.
+    # narrow-KV mapping. With a window (and equal blocks + zero
+    # offsets, see _flash_call), both grids band their innermost sweep
+    # to the tiles inside the window — the index maps slide with the
+    # middle grid index, so out-of-band tiles are never DMA'd.
+    band = None
+    if window is not None and causal and block_q == block_k and band_ok:
+        band = min(max(tq // block_q, tk // block_k),
+                   -(-(window - 1) // block_k) + 1)
+    n_q_tiles = tq // block_q
+
     def qmap(sel, row=lambda i: i):
         return lambda i, a, b, s: (row(i), sel(a, b), 0)
 
     first = lambda a, b: a
     second = lambda a, b: b
 
+    def q_band_map(row=lambda i: i):
+        # dkdv: fetch q tile kb + qi (clamped); middle index a = kb.
+        return lambda i, a, b, s: (
+            row(i),
+            b if band is None else jax.lax.min(a + b, n_q_tiles - 1),
+            0,
+        )
+
     dkdv_grid = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(bh, tk // block_k, tq // block_q),
+        grid=(bh, tk // block_k,
+              band if band is not None else tq // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), qmap(second)),   # q
-            pl.BlockSpec((1, block_q, d), qmap(second)),   # do
-            pl.BlockSpec((1, block_q, 1), qmap(second)),   # L
-            pl.BlockSpec((1, block_q, 1), qmap(second)),   # delta
+            pl.BlockSpec((1, block_q, d), q_band_map()),   # q
+            pl.BlockSpec((1, block_q, d), q_band_map()),   # do
+            pl.BlockSpec((1, block_q, 1), q_band_map()),   # L
+            pl.BlockSpec((1, block_q, 1), q_band_map()),   # delta
             pl.BlockSpec((1, block_k, d), qmap(first, kvrow)),   # k
             pl.BlockSpec((1, block_k, d), qmap(first, kvrow)),   # v
         ],
@@ -584,7 +668,8 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
         ],
     )
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkdv_kernel, causal=causal, scale=scale),
+        functools.partial(_bwd_dkdv_kernel, causal=causal, window=window,
+                          band=band, n_q_tiles=n_q_tiles, scale=scale),
         grid_spec=dkdv_grid,
         out_shape=[
             jax.ShapeDtypeStruct((bh, tk, d), jnp.float32, vma=vma),
@@ -598,12 +683,21 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
         interpret=interpret,
     )(offs, q3, do3, L, delta, k3, v3)
 
+    def kv_band_map(row=lambda i: i):
+        # dq: fetch k tile a - (band-1) + b (clamped); middle index = q tile.
+        return lambda i, a, b, s: (
+            row(i),
+            b if band is None else jax.lax.max(a - (band - 1) + b, 0),
+            0,
+        )
+
     dq_grid = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(bh, tq // block_q, tk // block_k),
+        grid=(bh, tq // block_q,
+              band if band is not None else tk // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_k, d), qmap(second, kvrow)),  # k
-            pl.BlockSpec((1, block_k, d), qmap(second, kvrow)),  # v
+            pl.BlockSpec((1, block_k, d), kv_band_map(kvrow)),  # k
+            pl.BlockSpec((1, block_k, d), kv_band_map(kvrow)),  # v
             pl.BlockSpec((1, block_q, d), qmap(first)),    # do
             pl.BlockSpec((1, block_q, 1), qmap(first)),    # L
             pl.BlockSpec((1, block_q, 1), qmap(first)),    # delta
@@ -614,7 +708,8 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
         ],
     )
     (dq,) = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale),
+        functools.partial(_bwd_dq_kernel, causal=causal, window=window,
+                          band=band, scale=scale),
         grid_spec=dq_grid,
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq, d), jnp.float32, vma=vma),
@@ -629,23 +724,34 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def flash_attention(q, k, v, causal: bool = False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = False, window=None):
     """Fused single-device attention, ``[B, H, T, D]`` → same.
 
     GQA/MQA: ``k``/``v`` may be ``[B, H_kv, T, D]`` with
     ``H % H_kv == 0`` — the kernels read the narrow KV directly (no
     materialized head repeat) and dk/dv come back in the narrow shape.
 
+    ``window``: sliding-window (local) attention — position ``i``
+    attends to ``[i - window + 1, i]``; requires ``causal``. The
+    forward and both backward grids shrink their inner sweep to the
+    window band (out-of-band tiles are never DMA'd), so cost scales as
+    O(T·window) instead of O(T²/2) — measured 4x at T=16k, W=1024.
+
     Forward runs the Pallas kernel; backward runs the two Pallas
     FlashAttention-2 kernels above, recomputing P from the saved
     logsumexp (O(T) residual memory).
     """
-    out, _ = _flash_fwd(q, k, v, causal)
+    out, _ = _flash_fwd(q, k, v, causal, window)
     return out
 
 
-def _flash_fwd(q, k, v, causal):
+def _flash_fwd(q, k, v, causal, window=None):
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal attention")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     b, h, t, d = q.shape
     h_kv = k.shape[1]
     bh = b * h
@@ -656,6 +762,8 @@ def _flash_fwd(q, k, v, causal):
         v.reshape(b * h_kv, t, d),
         o0, m0, l0, 0, 0,
         causal=causal,
+        window=window,
+        band_ok=True,  # _flash_fwd always calls with q_off == k_off == 0
         block_q=bq_blk,
         block_k=bk_blk,
         q_heads=h,
@@ -668,7 +776,7 @@ def _flash_fwd(q, k, v, causal):
     return out, (q, k, v, out, L)
 
 
-def _flash_bwd(causal, res, g):
+def _flash_bwd(causal, window, res, g):
     q, k, v, out, L = res
     b, h, t, d = q.shape
     h_kv = k.shape[1]
@@ -684,6 +792,8 @@ def _flash_bwd(causal, res, g):
         v.reshape(b * h_kv, t, d),
         g.astype(q.dtype).reshape(bh, t, d), L, delta, 0, 0,
         causal=causal,
+        window=window,
+        band_ok=True,  # the vjp always runs with q_off == k_off == 0
         block_q=bq_blk,
         block_k=bk_blk,
         q_heads=h,
