@@ -120,6 +120,10 @@ def _decode_sub_block(sub, x, k_cache, v_cache, pos, cfg, tp, ep):
                    preferred_element_type=jnp.float32)
     s = s / (cfg.head_dim ** 0.5)
     live = jnp.arange(max_len) <= pos                 # [max_len]
+    if cfg.attn_window:
+        # Sliding window: only the last attn_window positions stay
+        # live, matching the training forward's banded mask.
+        live &= jnp.arange(max_len) > pos - cfg.attn_window
     s = jnp.where(live[None, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
     a = jnp.einsum("bhtT,bhTd->bhtd", p, vw,

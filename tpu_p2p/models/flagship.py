@@ -96,6 +96,10 @@ class FlagshipConfig:
     # benchmark model); > 0 adds a tied token embedding ("emb",
     # replicated) — inputs become int token ids, outputs logits, and
     # make_flagship_lm_train_step trains with cross-entropy.
+    attn_window: int = 0     # > 0: sliding-window (local) attention —
+    # each position attends to its last `attn_window` positions.
+    # Needs causal=True and a full-sequence local view (sp size 1 or
+    # sp_strategy="ulysses"); the flash path uses the banded kernels.
 
     def __post_init__(self) -> None:
         # Strict, because a typo ("zigzag", "ring-zigzag") would fall
@@ -106,6 +110,12 @@ class FlagshipConfig:
                 f"unknown sp_strategy {self.sp_strategy!r}; expected "
                 "'ring', 'ring_zigzag', or 'ulysses'"
             )
+        if self.attn_window < 0:
+            raise ValueError(
+                f"attn_window must be >= 0, got {self.attn_window}"
+            )
+        if self.attn_window and not self.causal:
+            raise ValueError("attn_window requires causal=True")
 
     @property
     def model_dim(self) -> int:
@@ -281,25 +291,32 @@ def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
             )
         q = apply_rope(q, positions)
         k = apply_rope(k, positions)
+    window = cfg.attn_window or None
     if sp is not None and cfg.sp_strategy == "ulysses":
         from tpu_p2p.ops.ulysses import ulysses_attention_local
 
         a = ulysses_attention_local(q, k, v, sp, causal=cfg.causal,
-                                    use_flash=cfg.use_flash)
+                                    use_flash=cfg.use_flash, window=window)
     elif sp is not None and sp_size > 1:
         if cfg.use_flash:
             raise ValueError(
                 "use_flash requires sp_strategy='ulysses' (or sp size 1): "
                 "the ring path's streaming flash kernel is forward-only"
             )
+        if window is not None:
+            raise ValueError(
+                "attn_window needs a full-sequence local view: use "
+                "sp_strategy='ulysses' or sp size 1 (the ring paths "
+                "don't window their block masks)"
+            )
         a = ring_attention_local(q, k, v, sp, causal=cfg.causal,
                                  layout=layout)
     elif cfg.use_flash:  # size-1 sp (or no sp axis): sequence is local
         from tpu_p2p.ops.flash_attention import flash_attention
 
-        a = flash_attention(q, k, v, cfg.causal)
+        a = flash_attention(q, k, v, cfg.causal, window)
     else:
-        a = dense_attention(q, k, v, causal=cfg.causal)
+        a = dense_attention(q, k, v, causal=cfg.causal, window=window)
     y = jnp.einsum("bhtd,hdm->btm", a, sub_params["wo"])
     if tp is not None:
         y = jax.lax.psum(y, tp)  # Megatron join of head shards

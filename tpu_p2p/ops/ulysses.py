@@ -52,7 +52,7 @@ def _seq_to_heads(x, axis_name: str):
 
 
 def ulysses_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
-                            use_flash: bool = False):
+                            use_flash: bool = False, window=None):
     """Per-shard Ulysses attention body — call inside ``shard_map``.
 
     ``q, k, v``: local blocks ``[B, H, T_local, D]``, sequence sharded
@@ -85,9 +85,9 @@ def ulysses_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
     if use_flash:
         from tpu_p2p.ops.flash_attention import flash_attention
 
-        ah = flash_attention(qh, kh, vh, causal)
+        ah = flash_attention(qh, kh, vh, causal, window)
     else:
-        ah = dense_attention(qh, kh, vh, causal=causal)
+        ah = dense_attention(qh, kh, vh, causal=causal, window=window)
     return _seq_to_heads(ah, axis_name)
 
 
