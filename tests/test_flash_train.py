@@ -94,10 +94,13 @@ def test_flagship_flash_on_trivial_sp_axis():
     assert np.isfinite(float(loss))
 
 
-def test_flagship_flash_rejects_multi_device_ring():
+def test_flagship_flash_multi_device_ring_trains():
+    # Historically rejected (the streaming kernel had no VJP); now the
+    # ring flash path trains — exactness is pinned by
+    # tests/test_ring_flash.py, this guards the sp-only mesh wiring.
     mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 1, 2, 1, 1), F.AXES)
     cfg = _flagship_cfg(sp_strategy="ring", use_flash=True)
     params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
     x, t = F.flagship_example_batch(cfg, mesh)
-    with pytest.raises(ValueError, match="forward-only"):
-        F.make_flagship_train_step(mesh, cfg, lr=1e-2)(params, x, t)
+    _, loss = F.make_flagship_train_step(mesh, cfg, lr=1e-2)(params, x, t)
+    assert np.isfinite(float(loss))

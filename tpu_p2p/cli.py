@@ -82,7 +82,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture a jax.profiler trace of the run")
     p.add_argument("--flash", action="store_true",
                    help="ring_attention: use the Pallas flash kernel for the "
-                        "block-accumulate step (forward-only fast path)")
+                        "block-accumulate step")
     p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
                    help="testing: force CPU platform with N simulated devices")
     p.add_argument("--list-devices", action="store_true",

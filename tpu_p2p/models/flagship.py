@@ -84,10 +84,10 @@ class FlagshipConfig:
     # the step; autodiff turns the gather's transpose into the ZeRO
     # gradient reduce-scatter. See tpu_p2p/parallel/fsdp.py.
     use_flash: bool = False  # Pallas flash kernel for the attention
-    # math. Trainable with sp_strategy="ulysses" (local attention sees
-    # the full sequence, so the custom-vjp kernel drops in) and with
-    # sp size 1; the ring path's streaming-carry kernel is
-    # forward-only, so ring + use_flash raises.
+    # math, trainable under every sp_strategy: Ulysses sees the full
+    # sequence locally (the standalone custom-vjp kernel drops in);
+    # the ring paths ride tpu_p2p.ops.ring_flash — the FA2 block
+    # backward distributed over the same KV rotation ring.
     rope: bool = False       # rotary position embeddings, applied to
     # q/k per *global* position before any KV movement — so roped
     # blocks rotate through the ring, reshard through Ulysses, or sit
@@ -298,11 +298,6 @@ def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
         a = ulysses_attention_local(q, k, v, sp, causal=cfg.causal,
                                     use_flash=cfg.use_flash, window=window)
     elif sp is not None and sp_size > 1:
-        if cfg.use_flash:
-            raise ValueError(
-                "use_flash requires sp_strategy='ulysses' (or sp size 1): "
-                "the ring path's streaming flash kernel is forward-only"
-            )
         if window is not None:
             raise ValueError(
                 "attn_window needs a full-sequence local view: use "
@@ -310,7 +305,7 @@ def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
                 "don't window their block masks)"
             )
         a = ring_attention_local(q, k, v, sp, causal=cfg.causal,
-                                 layout=layout)
+                                 use_flash=cfg.use_flash, layout=layout)
     elif cfg.use_flash:  # size-1 sp (or no sp axis): sequence is local
         from tpu_p2p.ops.flash_attention import flash_attention
 

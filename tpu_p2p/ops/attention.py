@@ -154,9 +154,10 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
     the local accumulate step.
 
     ``use_flash=True`` runs each block's accumulate step in the Pallas
-    kernel (:func:`tpu_p2p.ops.flash_attention.flash_carry_block`) —
-    the forward/benchmark fast path; keep the default jnp path for
-    training (the Pallas carry step has no VJP).
+    kernel by delegating to
+    :func:`tpu_p2p.ops.ring_flash.ring_flash_attention` — fully
+    differentiable (the backward re-rotates KV around the same ring,
+    FlashAttention-2 block recipe with traveling dk/dv accumulators).
 
     ``layout="zigzag"`` expects inputs pre-sharded in the zigzag order
     (:func:`to_zigzag`) and returns output in the same order — the
@@ -167,6 +168,10 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
     """
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
+    if use_flash:
+        from tpu_p2p.ops.ring_flash import ring_flash_attention
+
+        return ring_flash_attention(q, k, v, axis_name, causal, layout)
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, t, d = q.shape
@@ -189,36 +194,6 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
         return jnp.where(visible[None, None], s, NEG_INF)
 
     def accumulate(o, m, l, k_blk, v_blk, src_block):
-        # The half-block split exists only for the causal offset math;
-        # non-causal hops use the cheaper single full-block call.
-        if use_flash and layout == "zigzag" and causal:
-            from tpu_p2p.ops.flash_attention import flash_carry_block
-
-            half = t // 2
-            q_lo, q_hi = zigzag_chunks(my, n, t)
-            k_lo, k_hi = zigzag_chunks(src_block, n, t)
-            # Four contiguous half×half passes; each q half's carry
-            # slice accumulates over both KV halves.
-            for qs, q_off in ((slice(0, half), q_lo),
-                              (slice(half, t), q_hi)):
-                oq, mq, lq = o[:, :, qs], m[:, :, qs], l[:, :, qs]
-                for ks, k_off in ((slice(0, half), k_lo),
-                                  (slice(half, t), k_hi)):
-                    oq, mq, lq = flash_carry_block(
-                        q[:, :, qs], k_blk[:, :, ks], v_blk[:, :, ks],
-                        oq, mq, lq, q_off, k_off, causal=causal,
-                    )
-                o = o.at[:, :, qs].set(oq)
-                m = m.at[:, :, qs].set(mq)
-                l = l.at[:, :, qs].set(lq)
-            return o, m, l
-        if use_flash:
-            from tpu_p2p.ops.flash_attention import flash_carry_block
-
-            return flash_carry_block(
-                q, k_blk, v_blk, o, m, l, my * t, src_block * t,
-                causal=causal,
-            )
         s = block_mask(_block_scores(q, repeat_kv(k_blk, h), scale),
                        src_block)
         return _merge(o, m, l, s, repeat_kv(v_blk, h))

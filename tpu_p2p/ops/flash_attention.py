@@ -411,6 +411,42 @@ def flash_carry_block(q, k, v, o, m, l, q_off, k_off, *,
     )
 
 
+def flash_bwd_block(q, k, v, do, L, delta, q_off, k_off, *,
+                    causal: bool = False, interpret=None):
+    """FlashAttention-2 backward for one q-block × KV-block pair given
+    the *global* logsumexp and delta — the ring-hop gradient step
+    (:mod:`tpu_p2p.ops.ring_flash` rotates KV blocks through this the
+    way the forward rotates them through :func:`flash_carry_block`).
+
+    ``q/do [B, H, Tq, D]`` vs ``k/v [B, H_kv, Tk, D]``;
+    ``L``/``delta [B, H, Tq]`` are the forward's logsumexp and
+    ``rowsum(dO·O)`` over the *whole* sequence, which is what makes
+    per-block contributions sum exactly to the full gradient. Returns
+    ``(dq [B,H,Tq,D], dk [B,H_kv,Tk,D], dv)`` in float32 — partial
+    sums for the caller to accumulate; GQA groups already folded.
+    """
+    b, h, tq, d = q.shape
+    h_kv, tk = k.shape[1], k.shape[2]
+    bh = b * h
+    interpret = _interpret_default() if interpret is None else interpret
+    bq_blk, bk_blk = _bwd_blocks(tq, tk, d)
+    dq, dk, dv = _flash_bwd_call(
+        q.reshape(bh, tq, d), k.reshape(b * h_kv, tk, d),
+        v.reshape(b * h_kv, tk, d), do.astype(q.dtype).reshape(bh, tq, d),
+        L.reshape(bh, tq), delta.reshape(bh, tq), q_off, k_off,
+        causal=causal, block_q=bq_blk, block_k=bk_blk, q_heads=h,
+        interpret=interpret,
+    )
+    if h_kv != h:
+        group = h // h_kv
+        dk = dk.reshape(b, h_kv, group, tk, d).sum(2)
+        dv = dv.reshape(b, h_kv, group, tk, d).sum(2)
+    else:
+        dk = dk.reshape(b, h_kv, tk, d)
+        dv = dv.reshape(b, h_kv, tk, d)
+    return dq.reshape(b, h, tq, d), dk, dv
+
+
 # Backward tiles share _default_blocks: (1024, 1024) measured best on
 # v5e at T=16k/D=128 for the backward too — 94 TFLOP/s fwd+bwd at the
 # conventional 3.5x-forward accounting vs 75 with 512-tiles (the

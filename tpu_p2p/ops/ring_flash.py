@@ -1,0 +1,214 @@
+"""Trainable ring flash attention — the flash kernel streamed over the
+ring transport, differentiable end to end.
+
+The reference has no attention code at all (its single source file is a
+transport benchmark, ``/root/reference/p2p_matrix.cc``); ring attention
+exists here because the shift-by-1 ``ppermute`` it rides is exactly the
+transfer pattern the reference measures (SURVEY.md §5 "long-context /
+sequence parallelism"). :mod:`tpu_p2p.ops.attention` supplies the plain
+jnp ring; this module is its Pallas fast path with a custom VJP, so
+``use_flash`` no longer forces the Ulysses strategy for training.
+
+Forward — identical math to the jnp ring, but each hop's accumulate
+runs in the flash kernel (:func:`flash_carry_block`): KV blocks rotate
+right around the ring while every device folds them into its
+``(o, m, l)`` streaming-softmax carry. The saved residual is O(T_local)
+per device: inputs, output, and the logsumexp ``L = m + log l``.
+
+Backward — the FlashAttention-2 block recipe
+(:func:`flash_bwd_block`) distributed over the same ring: because
+``P = exp(S - L)`` needs only the *global* ``L`` (and
+``delta = rowsum(dO·O)``, both local by construction), each KV block's
+``dk/dv`` contribution can be computed wherever the block happens to
+be. So the backward re-rotates KV around the ring and sends a float32
+``(dk, dv)`` accumulator *traveling with each block*; after a full
+rotation (n hops) every accumulator arrives back at its owner carrying
+all n devices' contributions, while ``dq`` accumulates in place. Per
+hop each device ships ``k, v, dk, dv`` — same neighbor-only traffic
+pattern as the forward, ~3x the bytes (f32 accumulators vs two bf16
+blocks); the last hop ships only the accumulators.
+
+Causal block skipping carries over untouched: the kernels' tile
+liveness tests use global position offsets, so hops whose KV block is
+entirely in the local queries' future cost no MXU work — and the
+zigzag layout (``layout="zigzag"``, :func:`zigzag_chunks`) balances
+that live work across ranks in forward and backward alike.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from tpu_p2p.ops.attention import NEG_INF, finalize, zigzag_chunks
+from tpu_p2p.parallel.collectives import ring_edges as _ring_edges
+
+
+def _halves(rank, n: int, t: int):
+    """Zigzag half-slices of a local block with their global offsets."""
+    half = t // 2
+    lo, hi = zigzag_chunks(rank, n, t)
+    return ((slice(0, half), lo), (slice(half, t), hi))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
+                         layout: str = "contiguous"):
+    """Per-shard ring attention on the flash kernel — call inside
+    ``shard_map``; drop-in for the ``use_flash`` path of
+    :func:`tpu_p2p.ops.attention.ring_attention_local`, but trainable.
+
+    ``q [B, H, T_local, D]`` vs ``k/v [B, H_kv, T_local, D]`` (GQA:
+    ``H % H_kv == 0``; the rotating blocks — and the backward's
+    traveling gradient accumulators — stay in the narrow KV head
+    count). ``layout="zigzag"`` expects inputs pre-permuted by
+    :func:`tpu_p2p.ops.attention.to_zigzag`.
+    """
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, layout)
+    return out
+
+
+def _accumulate(q, k_blk, v_blk, o, m, l, my, src, n, causal, layout):
+    """Fold one KV block into the carry with global-position offsets."""
+    from tpu_p2p.ops.flash_attention import flash_carry_block
+
+    t = q.shape[2]
+    if layout == "zigzag" and causal:
+        # Four contiguous half×half passes (the kernel's offset-based
+        # masking needs contiguous position runs); each q half's carry
+        # slice accumulates over both KV halves.
+        for qs, q_off in _halves(my, n, t):
+            oq, mq, lq = o[:, :, qs], m[:, :, qs], l[:, :, qs]
+            for ks, k_off in _halves(src, n, t):
+                oq, mq, lq = flash_carry_block(
+                    q[:, :, qs], k_blk[:, :, ks], v_blk[:, :, ks],
+                    oq, mq, lq, q_off, k_off, causal=causal,
+                )
+            o = o.at[:, :, qs].set(oq)
+            m = m.at[:, :, qs].set(mq)
+            l = l.at[:, :, qs].set(lq)
+        return o, m, l
+    # Contiguous (and non-causal zigzag, where offsets are unused).
+    return flash_carry_block(q, k_blk, v_blk, o, m, l, my * t, src * t,
+                             causal=causal)
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, layout):
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, h, t, d = q.shape
+    if layout == "zigzag" and t % 2:
+        raise ValueError(f"zigzag needs an even local length, got {t}")
+    o = jnp.zeros((b, h, t, d), jnp.float32)
+    m = jnp.full((b, h, t), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, t), jnp.float32)
+    edges = _ring_edges(n)
+
+    o, m, l = _accumulate(q, k, v, o, m, l, my, my, n, causal, layout)
+
+    def hop(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, edges)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, edges)
+        src = jax.lax.rem(my - i - 1 + n + n, n)
+        o2, m2, l2 = _accumulate(q, k_nxt, v_nxt, o, m, l, my, src,
+                                 n, causal, layout)
+        return (o2, m2, l2, k_nxt, v_nxt), None
+
+    if n > 1:
+        (o, m, l, _, _), _ = jax.lax.scan(
+            hop, (o, m, l, k, v), jnp.arange(n - 1)
+        )
+    out = finalize(o, m, l, q.dtype)
+    # Logsumexp residual for the backward; fully-masked rows (l == 0,
+    # impossible for causal ring queries but kept total) get +1e30 so
+    # exp(s - L) underflows to an all-zero P row in the kernels.
+    L = jnp.where(l > 0.0, m + jnp.log(jnp.where(l > 0.0, l, 1.0)), 1e30)
+    return out, (q, k, v, out, L)
+
+
+def _block_grads(dq, dka, dva, q, k_blk, v_blk, g, L, delta, my, src, n,
+                 causal, layout):
+    """One block's (dq, dk, dv) contributions, offsets as in forward."""
+    from tpu_p2p.ops.flash_attention import flash_bwd_block
+
+    t = q.shape[2]
+    if layout == "zigzag" and causal:
+        for qs, q_off in _halves(my, n, t):
+            for ks, k_off in _halves(src, n, t):
+                dq_h, dk_h, dv_h = flash_bwd_block(
+                    q[:, :, qs], k_blk[:, :, ks], v_blk[:, :, ks],
+                    g[:, :, qs], L[:, :, qs], delta[:, :, qs],
+                    q_off, k_off, causal=causal,
+                )
+                dq = dq.at[:, :, qs].add(dq_h)
+                dka = dka.at[:, :, ks].add(dk_h)
+                dva = dva.at[:, :, ks].add(dv_h)
+        return dq, dka, dva
+    dq_b, dk_b, dv_b = flash_bwd_block(q, k_blk, v_blk, g, L, delta,
+                                       my * t, src * t, causal=causal)
+    return dq + dq_b, dka + dk_b, dva + dv_b
+
+
+def _ring_flash_bwd(axis_name, causal, layout, res, g):
+    q, k, v, out, L = res
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, h, t, d = q.shape
+    h_kv = k.shape[1]
+    # delta = rowsum(dO·O) — global per construction (out is the
+    # normalized full-ring output), cheap elementwise, XLA fuses it.
+    # From the *unrounded* cotangent, like _flash_bwd: delta scales
+    # every ds term, so bf16-rounding it first would make ring-flash
+    # gradients noisier than the sp=1/ulysses path.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    g = g.astype(q.dtype)
+    dq = jnp.zeros((b, h, t, d), jnp.float32)
+    dka = jnp.zeros((b, h_kv, t, d), jnp.float32)
+    dva = jnp.zeros((b, h_kv, t, d), jnp.float32)
+    # Under a vma-checked shard_map the fresh zero accumulators are
+    # unvarying while the scan body's outputs vary — promote them (and
+    # anything else lagging) to the union before the carry loop.
+    from tpu_p2p.ops.flash_attention import _union_vma
+
+    _, (dq, dka, dva, q, k, v, g, L, delta) = _union_vma(
+        dq, dka, dva, q, k, v, g, L, delta
+    )
+    edges = _ring_edges(n)
+
+    def hop(carry, i):
+        dq, k_cur, v_cur, dka, dva = carry
+        src = jax.lax.rem(my - i + n + n, n)
+        dq, dka, dva = _block_grads(dq, dka, dva, q, k_cur, v_cur, g, L,
+                                    delta, my, src, n, causal, layout)
+        # The (dk, dv) accumulator travels WITH its KV block: after a
+        # full rotation both are back at the owner.
+        k_cur = jax.lax.ppermute(k_cur, axis_name, edges)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, edges)
+        dka = jax.lax.ppermute(dka, axis_name, edges)
+        dva = jax.lax.ppermute(dva, axis_name, edges)
+        return (dq, k_cur, v_cur, dka, dva), None
+
+    if n > 1:
+        (dq, k_last, v_last, dka, dva), _ = jax.lax.scan(
+            hop, (dq, k, v, dka, dva), jnp.arange(n - 1)
+        )
+        # Final block (src = my+1 after n-1 rotations): accumulate,
+        # then ship only the accumulators home — k/v need not travel.
+        dq, dka, dva = _block_grads(
+            dq, dka, dva, q, k_last, v_last, g, L, delta, my,
+            jax.lax.rem(my + 1, n), n, causal, layout,
+        )
+        dka = jax.lax.ppermute(dka, axis_name, edges)
+        dva = jax.lax.ppermute(dva, axis_name, edges)
+    else:
+        dq, dka, dva = _block_grads(dq, dka, dva, q, k, v, g, L, delta,
+                                    my, my, n, causal, layout)
+    return dq.astype(q.dtype), dka.astype(k.dtype), dva.astype(v.dtype)
+
+
+ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)
