@@ -71,6 +71,80 @@ def test_ring_flash_grads_match_dense(causal, layout):
                                    atol=2e-4, rtol=2e-4, err_msg=name)
 
 
+@pytest.mark.parametrize("use_flash", [False, True])
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+@pytest.mark.parametrize("window", [5, 24])
+def test_ring_window_grads_match_dense(use_flash, layout, window):
+    # window=5 fits inside one local block (T_local=16: whole hops go
+    # dead); window=24 spans block boundaries.
+    n = 4
+    mesh = _mesh(n)
+    q, k, v = _qkv(seed=2)
+    spec = P(None, None, "sp", None)
+
+    def f(q, k, v):
+        return A.ring_attention_local(q, k, v, "sp", causal=True,
+                                      use_flash=use_flash, layout=layout,
+                                      window=window)
+
+    sm = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
+                               out_specs=spec, check_vma=not use_flash))
+    if layout == "zigzag":
+        qs, ks, vs = (A.to_zigzag(x, n) for x in (q, k, v))
+    else:
+        qs, ks, vs = q, k, v
+    got = sm(qs, ks, vs)
+    if layout == "zigzag":
+        got = A.from_zigzag(got, n)
+    want = A.dense_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    g_r = jax.grad(lambda q, k, v: jnp.sum(sm(q, k, v) ** 2),
+                   argnums=(0, 1, 2))(qs, ks, vs)
+    g_d = jax.grad(
+        lambda q, k, v: jnp.sum(
+            A.dense_attention(q, k, v, causal=True, window=window) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    if layout == "zigzag":
+        g_r = tuple(A.from_zigzag(x, n) for x in g_r)
+    for a, b, name in zip(g_r, g_d, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4, err_msg=name)
+
+
+def test_live_hops_truncation():
+    # Windowed contiguous rings drop provably-dead rotations: device
+    # my's queries see only KV blocks my-H..my, H = ceil((window-1)/T).
+    from tpu_p2p.ops.ring_flash import _live_hops
+
+    assert _live_hops(8, 16, True, "contiguous", None) == 7
+    assert _live_hops(8, 16, True, "contiguous", 1) == 0   # local only
+    assert _live_hops(8, 16, True, "contiguous", 16) == 1
+    assert _live_hops(8, 16, True, "contiguous", 17) == 1  # boundary
+    assert _live_hops(8, 16, True, "contiguous", 18) == 2
+    assert _live_hops(8, 16, True, "contiguous", 10_000) == 7  # capped
+    # Zigzag ranks hold a mirrored late chunk — every hop stays live.
+    assert _live_hops(8, 16, True, "zigzag", 16) == 7
+    assert _live_hops(8, 16, False, "contiguous", None) == 7
+
+
+def test_ring_window_requires_causal():
+    mesh = _mesh(2)
+    q, k, v = _qkv(t=32)
+    spec = P(None, None, "sp", None)
+    for use_flash in (False, True):
+        def f(q, k, v):
+            return A.ring_attention_local(q, k, v, "sp", causal=False,
+                                          use_flash=use_flash, window=8)
+
+        with pytest.raises(ValueError, match="causal"):
+            jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
+                                  out_specs=spec,
+                                  check_vma=not use_flash))(q, k, v)
+
+
 def test_ring_flash_gqa_grads_match_dense():
     n = 4
     mesh = _mesh(n)

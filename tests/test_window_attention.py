@@ -107,14 +107,25 @@ def test_flagship_attn_window_validation():
 
     with pytest.raises(ValueError, match="causal"):
         F.FlagshipConfig(attn_window=8, causal=False)
+    # Historically the ring paths rejected attn_window ("needs a
+    # full-sequence local view"); now they window their block masks —
+    # the sp=2 ring forward must match the single-device windowed run.
     cfg = F.FlagshipConfig(batch=4, seq=64, heads=4, head_dim=8, stages=2,
                            microbatches=1, num_experts=2,
                            capacity_factor=4.0, attn_window=8)
     m = Mesh(np.array(jax.devices()[:2]).reshape(1, 1, 2, 1, 1), F.AXES)
-    params = F.place_flagship_params(F.init_flagship_params(cfg), m)
+    m1 = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1), F.AXES)
+    params = F.init_flagship_params(cfg)
     x, _ = F.flagship_example_batch(cfg, m)
-    with pytest.raises(ValueError, match="full-sequence"):
-        F.make_flagship_forward(m, cfg)(params, x)
+    x1, _ = F.flagship_example_batch(cfg, m1)  # same seed, other mesh
+    got = F.make_flagship_forward(m, cfg)(
+        F.place_flagship_params(params, m), x
+    )
+    want = F.make_flagship_forward(m1, cfg)(
+        F.place_flagship_params(params, m1), x1
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
 
 
 def test_windowed_decode_matches_training_forward():

@@ -97,9 +97,11 @@ class FlagshipConfig:
     # replicated) — inputs become int token ids, outputs logits, and
     # make_flagship_lm_train_step trains with cross-entropy.
     attn_window: int = 0     # > 0: sliding-window (local) attention —
-    # each position attends to its last `attn_window` positions.
-    # Needs causal=True and a full-sequence local view (sp size 1 or
-    # sp_strategy="ulysses"); the flash path uses the banded kernels.
+    # each position attends to its last `attn_window` positions. Needs
+    # causal=True; works under every sp_strategy (ring paths window
+    # their block masks via global offsets, and ring hops whose KV
+    # block falls entirely outside the window cost no kernel work;
+    # full-sequence flash views use the banded kernels).
 
     def __post_init__(self) -> None:
         # Strict, because a typo ("zigzag", "ring-zigzag") would fall
@@ -298,14 +300,9 @@ def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
         a = ulysses_attention_local(q, k, v, sp, causal=cfg.causal,
                                     use_flash=cfg.use_flash, window=window)
     elif sp is not None and sp_size > 1:
-        if window is not None:
-            raise ValueError(
-                "attn_window needs a full-sequence local view: use "
-                "sp_strategy='ulysses' or sp size 1 (the ring paths "
-                "don't window their block masks)"
-            )
         a = ring_attention_local(q, k, v, sp, causal=cfg.causal,
-                                 use_flash=cfg.use_flash, layout=layout)
+                                 use_flash=cfg.use_flash, layout=layout,
+                                 window=window)
     elif cfg.use_flash:  # size-1 sp (or no sp axis): sequence is local
         from tpu_p2p.ops.flash_attention import flash_attention
 

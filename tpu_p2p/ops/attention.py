@@ -138,7 +138,8 @@ def _block_positions(src_block, n: int, t: int, layout: str):
 
 def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
                          use_flash: bool = False,
-                         layout: str = "contiguous"):
+                         layout: str = "contiguous",
+                         window: Optional[int] = None):
     """Per-shard ring attention body — call inside ``shard_map``.
 
     ``q, k, v``: local blocks ``[B, H, T_local, D]``, sequence sharded
@@ -168,17 +169,22 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
     """
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
+    if window is not None and not causal:
+        raise ValueError("window requires causal attention")
     if use_flash:
         from tpu_p2p.ops.ring_flash import ring_flash_attention
 
-        return ring_flash_attention(q, k, v, axis_name, causal, layout)
+        return ring_flash_attention(q, k, v, axis_name, causal, layout,
+                                    window)
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, t, d = q.shape
     if layout == "zigzag" and t % 2:
         raise ValueError(f"zigzag needs an even local length, got {t}")
     scale = 1.0 / math.sqrt(d)
-    edges = [(i, (i + 1) % n) for i in range(n)]
+    from tpu_p2p.parallel.collectives import ring_edges
+
+    edges = ring_edges(n)
 
     o = jnp.zeros((b, h, t, d), jnp.float32)
     m = jnp.full((b, h, t), NEG_INF, jnp.float32)
@@ -191,6 +197,8 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
             return s
         k_pos = _block_positions(src_block, n, t, layout)
         visible = q_pos[:, None] >= k_pos[None, :]
+        if window is not None:
+            visible &= q_pos[:, None] - k_pos[None, :] < window
         return jnp.where(visible[None, None], s, NEG_INF)
 
     def accumulate(o, m, l, k_blk, v_blk, src_block):
@@ -210,9 +218,12 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
         o2, m2, l2 = accumulate(o, m, l, k_nxt, v_nxt, src)
         return (o2, m2, l2, k_nxt, v_nxt), None
 
-    if n > 1:
+    from tpu_p2p.ops.ring_flash import _live_hops
+
+    hops = _live_hops(n, t, causal, layout, window)
+    if hops > 0:
         (o, m, l, _, _), _ = jax.lax.scan(
-            hop, (o, m, l, k, v), jnp.arange(n - 1)
+            hop, (o, m, l, k, v), jnp.arange(hops)
         )
 
     # Fully-masked rows (can't happen for causal ring queries, but keep
@@ -222,7 +233,8 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
 
 @functools.lru_cache(maxsize=None)
 def ring_attention(mesh: Mesh, axis: str, causal: bool = False,
-                   use_flash: bool = False, layout: str = "contiguous"):
+                   use_flash: bool = False, layout: str = "contiguous",
+                   window: Optional[int] = None):
     """Jitted global ring attention over ``mesh``.
 
     Takes global ``[B, H, T, D]`` arrays with ``T`` sharded along
@@ -235,7 +247,8 @@ def ring_attention(mesh: Mesh, axis: str, causal: bool = False,
 
     def f(q, k, v):
         return ring_attention_local(q, k, v, axis, causal=causal,
-                                    use_flash=use_flash, layout=layout)
+                                    use_flash=use_flash, layout=layout,
+                                    window=window)
 
     # check_vma=False on the flash path: JAX's varying-manual-axes
     # tracking mis-propagates through pallas_call (its own error text

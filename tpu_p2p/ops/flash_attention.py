@@ -380,13 +380,16 @@ from tpu_p2p.ops.attention import finalize  # noqa: E402 — shared
 
 
 def flash_carry_block(q, k, v, o, m, l, q_off, k_off, *,
-                      causal: bool = False, interpret=None):
+                      causal: bool = False, window=None, interpret=None):
     """Fold one KV block into the carry — the ring-hop compute step.
 
     ``q [B, H, Tq, D]`` against ``k/v [B, H_kv, Tk, D]`` (GQA: ``H``
     a multiple of ``H_kv``) with global position offsets (traced
     scalars are fine — they ride scalar prefetch). Carry shapes:
-    ``o [B, H, Tq, D] f32``, ``m/l [B, H, Tq] f32``.
+    ``o [B, H, Tq, D] f32``, ``m/l [B, H, Tq] f32``. ``window``
+    restricts the (causal) mask to the last ``window`` positions;
+    offsets are traced here so the sweep stays un-banded — per-tile
+    liveness still skips dead tiles' compute.
     """
     b, h, tq, d = q.shape
     h_kv, tk = k.shape[1], k.shape[2]
@@ -399,6 +402,7 @@ def flash_carry_block(q, k, v, o, m, l, q_off, k_off, *,
         o.reshape(bh, tq, d), m.reshape(bh, tq), l.reshape(bh, tq),
         q_off, k_off,
         causal=causal,
+        window=window,
         block_q=bq_blk,
         block_k=bk_blk,
         q_heads=h,
@@ -412,7 +416,7 @@ def flash_carry_block(q, k, v, o, m, l, q_off, k_off, *,
 
 
 def flash_bwd_block(q, k, v, do, L, delta, q_off, k_off, *,
-                    causal: bool = False, interpret=None):
+                    causal: bool = False, window=None, interpret=None):
     """FlashAttention-2 backward for one q-block × KV-block pair given
     the *global* logsumexp and delta — the ring-hop gradient step
     (:mod:`tpu_p2p.ops.ring_flash` rotates KV blocks through this the
@@ -434,8 +438,8 @@ def flash_bwd_block(q, k, v, do, L, delta, q_off, k_off, *,
         q.reshape(bh, tq, d), k.reshape(b * h_kv, tk, d),
         v.reshape(b * h_kv, tk, d), do.astype(q.dtype).reshape(bh, tq, d),
         L.reshape(bh, tq), delta.reshape(bh, tq), q_off, k_off,
-        causal=causal, block_q=bq_blk, block_k=bk_blk, q_heads=h,
-        interpret=interpret,
+        causal=causal, window=window, block_q=bq_blk, block_k=bk_blk,
+        q_heads=h, interpret=interpret,
     )
     if h_kv != h:
         group = h // h_kv

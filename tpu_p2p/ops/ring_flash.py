@@ -53,9 +53,25 @@ def _halves(rank, n: int, t: int):
     return ((slice(0, half), lo), (slice(half, t), hi))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _live_hops(n: int, t: int, causal: bool, layout: str, window) -> int:
+    """Ring rotations that can carry a live KV block.
+
+    Contiguous causal layout with a sliding window: device ``my``'s
+    queries see only KV blocks ``my-H..my`` where
+    ``H = ceil((window-1)/T_local)`` — every later hop's block is
+    entirely behind the window (and wrap-around sources are entirely in
+    the future), so those rotations ship provably dead bytes and can be
+    dropped, not just compute-skipped. Zigzag holds a mirrored *late*
+    chunk on every rank, so all rotations stay live there.
+    """
+    if window is not None and causal and layout == "contiguous":
+        return min(n - 1, -(-(window - 1) // t))
+    return n - 1
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
-                         layout: str = "contiguous"):
+                         layout: str = "contiguous", window=None):
     """Per-shard ring attention on the flash kernel — call inside
     ``shard_map``; drop-in for the ``use_flash`` path of
     :func:`tpu_p2p.ops.attention.ring_attention_local`, but trainable.
@@ -66,11 +82,12 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
     count). ``layout="zigzag"`` expects inputs pre-permuted by
     :func:`tpu_p2p.ops.attention.to_zigzag`.
     """
-    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, layout)
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, layout, window)
     return out
 
 
-def _accumulate(q, k_blk, v_blk, o, m, l, my, src, n, causal, layout):
+def _accumulate(q, k_blk, v_blk, o, m, l, my, src, n, causal, layout,
+                window):
     """Fold one KV block into the carry with global-position offsets."""
     from tpu_p2p.ops.flash_attention import flash_carry_block
 
@@ -85,6 +102,7 @@ def _accumulate(q, k_blk, v_blk, o, m, l, my, src, n, causal, layout):
                 oq, mq, lq = flash_carry_block(
                     q[:, :, qs], k_blk[:, :, ks], v_blk[:, :, ks],
                     oq, mq, lq, q_off, k_off, causal=causal,
+                    window=window,
                 )
             o = o.at[:, :, qs].set(oq)
             m = m.at[:, :, qs].set(mq)
@@ -92,12 +110,14 @@ def _accumulate(q, k_blk, v_blk, o, m, l, my, src, n, causal, layout):
         return o, m, l
     # Contiguous (and non-causal zigzag, where offsets are unused).
     return flash_carry_block(q, k_blk, v_blk, o, m, l, my * t, src * t,
-                             causal=causal)
+                             causal=causal, window=window)
 
 
-def _ring_flash_fwd(q, k, v, axis_name, causal, layout):
+def _ring_flash_fwd(q, k, v, axis_name, causal, layout, window):
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
+    if window is not None and not causal:
+        raise ValueError("window requires causal attention")
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, t, d = q.shape
@@ -108,7 +128,8 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, layout):
     l = jnp.zeros((b, h, t), jnp.float32)
     edges = _ring_edges(n)
 
-    o, m, l = _accumulate(q, k, v, o, m, l, my, my, n, causal, layout)
+    o, m, l = _accumulate(q, k, v, o, m, l, my, my, n, causal, layout,
+                          window)
 
     def hop(carry, i):
         o, m, l, k_cur, v_cur = carry
@@ -116,12 +137,13 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, layout):
         v_nxt = jax.lax.ppermute(v_cur, axis_name, edges)
         src = jax.lax.rem(my - i - 1 + n + n, n)
         o2, m2, l2 = _accumulate(q, k_nxt, v_nxt, o, m, l, my, src,
-                                 n, causal, layout)
+                                 n, causal, layout, window)
         return (o2, m2, l2, k_nxt, v_nxt), None
 
-    if n > 1:
+    hops = _live_hops(n, t, causal, layout, window)
+    if hops > 0:
         (o, m, l, _, _), _ = jax.lax.scan(
-            hop, (o, m, l, k, v), jnp.arange(n - 1)
+            hop, (o, m, l, k, v), jnp.arange(hops)
         )
     out = finalize(o, m, l, q.dtype)
     # Logsumexp residual for the backward; fully-masked rows (l == 0,
@@ -132,7 +154,7 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, layout):
 
 
 def _block_grads(dq, dka, dva, q, k_blk, v_blk, g, L, delta, my, src, n,
-                 causal, layout):
+                 causal, layout, window):
     """One block's (dq, dk, dv) contributions, offsets as in forward."""
     from tpu_p2p.ops.flash_attention import flash_bwd_block
 
@@ -143,18 +165,19 @@ def _block_grads(dq, dka, dva, q, k_blk, v_blk, g, L, delta, my, src, n,
                 dq_h, dk_h, dv_h = flash_bwd_block(
                     q[:, :, qs], k_blk[:, :, ks], v_blk[:, :, ks],
                     g[:, :, qs], L[:, :, qs], delta[:, :, qs],
-                    q_off, k_off, causal=causal,
+                    q_off, k_off, causal=causal, window=window,
                 )
                 dq = dq.at[:, :, qs].add(dq_h)
                 dka = dka.at[:, :, ks].add(dk_h)
                 dva = dva.at[:, :, ks].add(dv_h)
         return dq, dka, dva
     dq_b, dk_b, dv_b = flash_bwd_block(q, k_blk, v_blk, g, L, delta,
-                                       my * t, src * t, causal=causal)
+                                       my * t, src * t, causal=causal,
+                                       window=window)
     return dq + dq_b, dka + dk_b, dva + dv_b
 
 
-def _ring_flash_bwd(axis_name, causal, layout, res, g):
+def _ring_flash_bwd(axis_name, causal, layout, window, res, g):
     q, k, v, out, L = res
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -184,7 +207,8 @@ def _ring_flash_bwd(axis_name, causal, layout, res, g):
         dq, k_cur, v_cur, dka, dva = carry
         src = jax.lax.rem(my - i + n + n, n)
         dq, dka, dva = _block_grads(dq, dka, dva, q, k_cur, v_cur, g, L,
-                                    delta, my, src, n, causal, layout)
+                                    delta, my, src, n, causal, layout,
+                                    window)
         # The (dk, dv) accumulator travels WITH its KV block: after a
         # full rotation both are back at the owner.
         k_cur = jax.lax.ppermute(k_cur, axis_name, edges)
@@ -193,21 +217,33 @@ def _ring_flash_bwd(axis_name, causal, layout, res, g):
         dva = jax.lax.ppermute(dva, axis_name, edges)
         return (dq, k_cur, v_cur, dka, dva), None
 
-    if n > 1:
+    hops = _live_hops(n, t, causal, layout, window)
+    if hops > 0:
         (dq, k_last, v_last, dka, dva), _ = jax.lax.scan(
-            hop, (dq, k, v, dka, dva), jnp.arange(n - 1)
+            hop, (dq, k, v, dka, dva), jnp.arange(hops)
         )
-        # Final block (src = my+1 after n-1 rotations): accumulate,
-        # then ship only the accumulators home — k/v need not travel.
+        # Final live block (src = my - hops): accumulate without
+        # rotating k/v any further.
         dq, dka, dva = _block_grads(
             dq, dka, dva, q, k_last, v_last, g, L, delta, my,
-            jax.lax.rem(my + 1, n), n, causal, layout,
+            jax.lax.rem(my - hops + n + n, n), n, causal, layout, window,
         )
-        dka = jax.lax.ppermute(dka, axis_name, edges)
-        dva = jax.lax.ppermute(dva, axis_name, edges)
+        # Ship only the accumulators home. They sit ``hops`` rotations
+        # ahead of their owners — continue forward the remaining
+        # ``n - hops`` or backtrack ``hops``, whichever is shorter
+        # (full un-windowed rotation: one forward hop).
+        if n - hops <= hops:
+            for _ in range(n - hops):
+                dka = jax.lax.ppermute(dka, axis_name, edges)
+                dva = jax.lax.ppermute(dva, axis_name, edges)
+        else:
+            rev = _ring_edges(n, -1)
+            for _ in range(hops):
+                dka = jax.lax.ppermute(dka, axis_name, rev)
+                dva = jax.lax.ppermute(dva, axis_name, rev)
     else:
         dq, dka, dva = _block_grads(dq, dka, dva, q, k, v, g, L, delta,
-                                    my, my, n, causal, layout)
+                                    my, my, n, causal, layout, window)
     return dq.astype(q.dtype), dka.astype(k.dtype), dva.astype(v.dtype)
 
 
