@@ -1,0 +1,183 @@
+"""Pre-norm RMSNorm (ln1/ln2/lnf) and the dense Megatron FFN
+(dense_ffn): cross-mesh parity, tp join, training, decode exactness,
+and executor coverage (GPipe, 1F1B, ZeRO)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding
+
+from tpu_p2p.models import decode as D
+from tpu_p2p.models import flagship as F
+
+
+def _mesh1():
+    return Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1), F.AXES)
+
+
+def _cfg(**kw):
+    base = dict(batch=8, seq=32, heads=4, head_dim=8, stages=2,
+                microbatches=2, num_experts=2, capacity_factor=4.0,
+                norm=True, dense_ffn=True)
+    base.update(kw)
+    return F.FlagshipConfig(**base)
+
+
+def test_param_shapes_norm_and_dense():
+    shapes = F.flagship_param_shapes(_cfg(vocab=64))
+    assert "wf1" in shapes and "wf2" in shapes
+    assert "router" not in shapes and "we1" not in shapes
+    assert shapes["ln1"] == (2, 32) and shapes["lnf"] == (32,)
+    # Gains init to ones, not random.
+    params = F.init_flagship_params(_cfg(vocab=64))
+    assert float(jnp.min(params["ln1"])) == 1.0
+    assert float(jnp.max(params["lnf"])) == 1.0
+
+
+def test_norm_dense_cross_mesh_parity():
+    cfg = _cfg(rope=True)
+    mesh8, mesh1 = F.build_mesh(8), _mesh1()
+    params = F.init_flagship_params(cfg)
+    x8, _ = F.flagship_example_batch(cfg, mesh8)
+    x1, _ = F.flagship_example_batch(cfg, mesh1)
+    got = F.make_flagship_forward(mesh8, cfg)(
+        F.place_flagship_params(params, mesh8), x8
+    )
+    want = F.make_flagship_forward(mesh1, cfg)(
+        F.place_flagship_params(params, mesh1), x1
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_dense_ffn_tp_megatron_join():
+    cfg = _cfg()
+    mesh_tp = Mesh(np.array(jax.devices()[:2]).reshape(1, 1, 1, 2, 1),
+                   F.AXES)
+    mesh1 = _mesh1()
+    params = F.init_flagship_params(cfg)
+    x_tp, _ = F.flagship_example_batch(cfg, mesh_tp)
+    x1, _ = F.flagship_example_batch(cfg, mesh1)
+    got = F.make_flagship_forward(mesh_tp, cfg)(
+        F.place_flagship_params(params, mesh_tp), x_tp
+    )
+    want = F.make_flagship_forward(mesh1, cfg)(
+        F.place_flagship_params(params, mesh1), x1
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_norm_dense_trains():
+    cfg = _cfg(rope=True)
+    mesh = F.build_mesh(8)
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    x, t = F.flagship_example_batch(cfg, mesh)
+    step = F.make_flagship_train_step(mesh, cfg, lr=5e-2)
+    losses = []
+    for _ in range(6):
+        params, loss = step(params, x, t)
+        losses.append(float(loss))
+    assert all(np.isfinite(v) for v in losses)
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_norm_dense_1f1b_and_zero_match_gpipe():
+    mesh = F.build_mesh(8)
+    cfg = _cfg()
+    params = F.init_flagship_params(cfg)
+    x, t = F.flagship_example_batch(cfg, mesh)
+    placed = F.place_flagship_params(params, mesh)
+    p_g, l_g = F.make_flagship_train_step(mesh, cfg, lr=1e-2)(placed, x, t)
+    # 1F1B executor: same update, different schedule.
+    p_fb = F.place_flagship_params_pipelined(params, mesh, cfg)
+    p_fb, l_fb = F.make_flagship_train_step_1f1b(mesh, cfg, lr=1e-2)(
+        p_fb, x, t
+    )
+    np.testing.assert_allclose(float(l_fb), float(l_g), rtol=1e-5)
+    back = F.unplace_flagship_params_pipelined(p_fb, mesh, cfg)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(back[k]), np.asarray(p_g[k]),
+                                   atol=2e-4, rtol=2e-4, err_msg=k)
+    # ZeRO storage: same update through gather-on-use.
+    cfg_z = dataclasses.replace(cfg, zero_dp=True)
+    p_z = F.place_flagship_params(params, mesh, cfg_z)
+    p_z, l_z = F.make_flagship_train_step(mesh, cfg_z, lr=1e-2)(p_z, x, t)
+    np.testing.assert_allclose(float(l_z), float(l_g), rtol=1e-5)
+
+
+def test_norm_dense_decode_matches_training_forward():
+    cfg = F.FlagshipConfig(batch=4, seq=24, heads=4, head_dim=8, stages=2,
+                           microbatches=1, num_experts=2,
+                           capacity_factor=4.0, norm=True, dense_ffn=True,
+                           rope=True, attn_window=8)
+    mesh = _mesh1()
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    x, _ = F.flagship_example_batch(cfg, mesh)
+    want = np.asarray(F.make_flagship_forward(mesh, cfg)(params, x))
+    step = D.make_flagship_decode_step(mesh, cfg)
+    cache = D.init_kv_cache(cfg, max_len=cfg.seq, mesh=mesh)
+    for t in range(cfg.seq):
+        cache, y_t = step(params, cache, x[:, t:t + 1, :], t)
+        np.testing.assert_allclose(np.asarray(y_t)[:, 0, :], want[:, t, :],
+                                   atol=1e-4, rtol=1e-4,
+                                   err_msg=f"position {t}")
+
+
+def test_lm_final_norm_decode_matches_forward():
+    cfg = _cfg(batch=4, seq=16, microbatches=1, vocab=64)
+    mesh = _mesh1()
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh, cfg)
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, (4, 16)), jnp.int32
+    )
+    want = np.asarray(F.make_flagship_lm_forward(mesh, cfg)(params, toks))
+    step = D.make_flagship_lm_decode_step(mesh, cfg)
+    cache = D.init_kv_cache(cfg, max_len=16, mesh=mesh)
+    for t in range(16):
+        cache, lg = step(params, cache, toks[:, t:t + 1], t)
+        np.testing.assert_allclose(np.asarray(lg)[:, 0, :], want[:, t, :],
+                                   atol=1e-3, rtol=1e-3,
+                                   err_msg=f"position {t}")
+
+
+def test_lm_norm_trains():
+    cfg = _cfg(vocab=64)
+    mesh = F.build_mesh(8)
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh, cfg)
+    toks = np.random.default_rng(1).integers(0, 64, (8, 33)).astype(np.int32)
+    sh = NamedSharding(mesh, F._lm_token_spec(mesh))
+    inp = jax.device_put(jnp.asarray(toks[:, :-1]), sh)
+    tgt = jax.device_put(jnp.asarray(toks[:, 1:]), sh)
+    step = F.make_flagship_lm_train_step(mesh, cfg, lr=5e-2)
+    losses = []
+    for _ in range(4):
+        params, loss = step(params, inp, tgt)
+        losses.append(float(loss))
+    assert all(np.isfinite(v) for v in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_moe_with_norm_still_works():
+    # norm composes with the MoE FFN too (dense_ffn=False).
+    cfg = _cfg(dense_ffn=False)
+    mesh8, mesh1 = F.build_mesh(8), _mesh1()
+    params = F.init_flagship_params(cfg)
+    assert "router" in params and "ln1" in params
+    x8, t8 = F.flagship_example_batch(cfg, mesh8)
+    x1, _ = F.flagship_example_batch(cfg, mesh1)
+    got = F.make_flagship_forward(mesh8, cfg)(
+        F.place_flagship_params(params, mesh8), x8
+    )
+    want = F.make_flagship_forward(mesh1, cfg)(
+        F.place_flagship_params(params, mesh1), x1
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    p, l = F.make_flagship_train_step(mesh8, cfg, lr=1e-2)(
+        F.place_flagship_params(params, mesh8), x8, t8
+    )
+    assert np.isfinite(float(l))

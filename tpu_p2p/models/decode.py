@@ -101,15 +101,20 @@ def init_kv_cache(cfg: FlagshipConfig, max_len: int, mesh: Mesh) -> Cache:
     return {"k": zeros(), "v": zeros()}
 
 
-def _decode_sub_block(sub, x, k_cache, v_cache, pos, cfg, tp, ep):
+def _decode_sub_block(sub, x, h, k_cache, v_cache, pos, cfg, tp, ep):
     """One transformer block on a single token, against the cache.
 
-    ``x``: ``[B_loc, 1, Dm]``; ``k_cache``/``v_cache``:
-    ``[B_loc, H_kv_loc, max_len, Dh]`` already holding this step's
-    K/V at ``pos``. Mirrors flagship._stage_sub_block's math.
+    ``x``: residual stream ``[B_loc, 1, Dm]``; ``h``: its pre-normed
+    twin (``== x`` when ``cfg.norm`` is off), computed once in
+    :func:`_decode_stack` and shared with the k/v projections there.
+    ``k_cache``/``v_cache``: ``[B_loc, H_kv_loc, max_len, Dh]`` already
+    holding this step's K/V at ``pos``. Mirrors
+    flagship._stage_sub_block's math.
     """
+    from tpu_p2p.models.flagship import _dense_ffn, _rms_norm
+
     max_len = k_cache.shape[2]
-    q = jnp.einsum("btm,hmd->bhtd", x, sub["wq"])     # [B, H, 1, Dh]
+    q = jnp.einsum("btm,hmd->bhtd", h, sub["wq"])     # [B, H, 1, Dh]
     if cfg.rope:
         from tpu_p2p.ops.rope import apply_rope
 
@@ -132,8 +137,11 @@ def _decode_sub_block(sub, x, k_cache, v_cache, pos, cfg, tp, ep):
     if tp is not None:
         y = jax.lax.psum(y, tp)
     x = x + y
+    h2 = _rms_norm(x, sub["ln2"]) if cfg.norm else x
+    if cfg.dense_ffn:
+        return x + _dense_ffn(sub, h2, tp)
     moe_params = {"router": sub["router"], "w1": sub["we1"], "w2": sub["we2"]}
-    tokens = x.reshape(-1, x.shape[-1])
+    tokens = h2.reshape(-1, h2.shape[-1])
     m_out = moe_layer_local(moe_params, tokens, cfg.moe(), ep_axis=ep)
     return x + m_out.reshape(x.shape)
 
@@ -144,14 +152,19 @@ def _decode_stack(params, cache: Cache, x, pos, cfg, tp, ep):
     token-level steps. ``x``: ``[B_loc, 1, Dm]``. Returns
     ``(cache, y)``.
     """
+    from tpu_p2p.models.flagship import _rms_norm
+
     k_all, v_all = cache["k"], cache["v"]
     for s in range(cfg.stages):
-        # Stage-major leaves only: 'emb' (vocab configs) has a vocab
-        # leading dim, not a stage one.
-        sub = {kk: vv[s] for kk, vv in params.items() if kk != "emb"}
-        # Project and write this token's K/V at pos (time axis 2).
-        k_t = jnp.einsum("btm,hmd->bhtd", x, sub["wk"])
-        v_t = jnp.einsum("btm,hmd->bhtd", x, sub["wv"])
+        # Stage-major leaves only: 'emb' (vocab-leading) and 'lnf'
+        # (stage-less) have no stage dim to slice.
+        sub = {kk: vv[s] for kk, vv in params.items()
+               if kk not in ("emb", "lnf")}
+        # Project and write this token's K/V at pos (time axis 2) —
+        # from the pre-normed activations, mirroring the train block.
+        h = _rms_norm(x, sub["ln1"]) if cfg.norm else x
+        k_t = jnp.einsum("btm,hmd->bhtd", h, sub["wk"])
+        v_t = jnp.einsum("btm,hmd->bhtd", h, sub["wv"])
         if cfg.rope:
             # Cache stores roped K (standard): the new token's K is
             # rotated by its position before the cache write, and
@@ -163,7 +176,7 @@ def _decode_stack(params, cache: Cache, x, pos, cfg, tp, ep):
         v_st = jax.lax.dynamic_update_slice_in_dim(v_all[s], v_t, pos, axis=2)
         k_all = jax.lax.dynamic_update_index_in_dim(k_all, k_st, s, 0)
         v_all = jax.lax.dynamic_update_index_in_dim(v_all, v_st, s, 0)
-        x = _decode_sub_block(sub, x, k_st, v_st, pos, cfg, tp, ep)
+        x = _decode_sub_block(sub, x, h, k_st, v_st, pos, cfg, tp, ep)
     return {"k": k_all, "v": v_all}, x
 
 
@@ -236,6 +249,10 @@ def make_flagship_lm_decode_step(mesh: Mesh, cfg: FlagshipConfig):
             jnp.dtype(cfg.dtype)
         )                                           # [B, 1, Dm]
         cache, y = _decode_stack(params, cache, x, pos, cfg, tp, ep)
+        if cfg.norm:
+            from tpu_p2p.models.flagship import _rms_norm
+
+            y = _rms_norm(y, params["lnf"])
         logits = jnp.einsum("btm,vm->btv", y.astype(jnp.float32),
                             params["emb"].astype(jnp.float32))
         return cache, logits

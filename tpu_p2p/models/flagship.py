@@ -96,6 +96,14 @@ class FlagshipConfig:
     # benchmark model); > 0 adds a tied token embedding ("emb",
     # replicated) — inputs become int token ids, outputs logits, and
     # make_flagship_lm_train_step trains with cross-entropy.
+    norm: bool = False       # pre-norm RMSNorm: learnable gains ln1
+    # (before attention) and ln2 (before the FFN) per stage, plus a
+    # final lnf before the LM unembed (vocab configs). Off by default
+    # so the benchmark model stays the bare composition of transports.
+    dense_ffn: bool = False  # replace the MoE FFN with a dense 2-layer
+    # gelu MLP (wf1/wf2), Megatron-sharded over tp (wf1 column-split,
+    # wf2 row-split, one psum join). num_experts/capacity_factor/ep are
+    # then unused — the ep mesh axis still shards data.
     attn_window: int = 0     # > 0: sliding-window (local) attention —
     # each position attends to its last `attn_window` positions. Needs
     # causal=True; works under every sp_strategy (ring paths window
@@ -192,26 +200,41 @@ def flagship_param_shapes(cfg: FlagshipConfig) -> Dict[str, Tuple[int, ...]]:
         "wk": (s, hkv, dm, dh),
         "wv": (s, hkv, dm, dh),
         "wo": (s, h, dh, dm),
-        "router": (s, dm, e),
-        "we1": (s, e, dm, f),
-        "we2": (s, e, f, dm),
     }
+    if cfg.dense_ffn:
+        shapes["wf1"] = (s, dm, f)
+        shapes["wf2"] = (s, f, dm)
+    else:
+        shapes["router"] = (s, dm, e)
+        shapes["we1"] = (s, e, dm, f)
+        shapes["we2"] = (s, e, f, dm)
+    if cfg.norm:
+        shapes["ln1"] = (s, dm)
+        shapes["ln2"] = (s, dm)
+        if cfg.vocab:
+            shapes["lnf"] = (dm,)
     if cfg.vocab:
         shapes["emb"] = (cfg.vocab, dm)
     return shapes
 
 
 _FAN_IN_DIM = {"wq": 2, "wk": 2, "wv": 2, "wo": 2, "router": 1,
-               "we1": 2, "we2": 2, "emb": 1}
+               "we1": 2, "we2": 2, "emb": 1, "wf1": 1, "wf2": 1}
+_GAIN_PARAMS = ("ln1", "ln2", "lnf")  # RMSNorm gains: init to ones
 
 
 def init_flagship_params(cfg: FlagshipConfig, seed: int = 0) -> Params:
     rng = np.random.default_rng(seed)
     dtype = jnp.dtype(cfg.dtype)
     return {
-        name: jnp.asarray(
-            rng.standard_normal(shape) / math.sqrt(shape[_FAN_IN_DIM[name]]),
-            dtype=dtype,
+        name: (
+            jnp.ones(shape, dtype)
+            if name in _GAIN_PARAMS
+            else jnp.asarray(
+                rng.standard_normal(shape)
+                / math.sqrt(shape[_FAN_IN_DIM[name]]),
+                dtype=dtype,
+            )
         )
         for name, shape in flagship_param_shapes(cfg).items()
     }
@@ -227,6 +250,11 @@ def _base_param_specs(mesh: Mesh) -> Dict[str, P]:
         "router": P(pp, None, None),
         "we1": P(pp, ep, None, None),
         "we2": P(pp, ep, None, None),
+        "wf1": P(pp, None, tp),   # dense FFN, Megatron column split
+        "wf2": P(pp, tp, None),   # …row split; psum joins the output
+        "ln1": P(pp, None),
+        "ln2": P(pp, None),
+        "lnf": P(None),
         "emb": P(None, None),  # tied embedding (vocab > 0); replicated
         # (ZeRO may still dp-shard it via the plan). Extra keys are
         # harmless for configs without a vocab.
@@ -256,8 +284,14 @@ def flagship_param_specs(mesh: Mesh,
     base = _base_param_specs(mesh)
     plan = _fsdp_plan(mesh, cfg)
     specs = fsdp.fsdp_specs(base, plan, "dp") if plan else base
-    if cfg is None or not cfg.vocab:
-        specs = {k: v for k, v in specs.items() if k != "emb"}
+    if cfg is not None:
+        # shard_map in_specs must mirror the params pytree exactly —
+        # keep only the keys this config's shapes actually produce.
+        specs = {k: specs[k] for k in flagship_param_shapes(cfg)}
+    else:
+        # No config: every stage-major leaf (pipelined placement looks
+        # specs up per param key); the stage-less leaves are excluded.
+        specs = {k: v for k, v in specs.items() if k not in ("emb", "lnf")}
     return specs
 
 
@@ -268,16 +302,26 @@ def flagship_data_spec(mesh: Mesh) -> P:
     return P(batch_axes if batch_axes else None, sp, None)
 
 
+def _rms_norm(x, gain, eps: float = 1e-6):
+    """RMSNorm in float32 with a learnable gain; RMSNorm(0) == 0, so
+    pipeline bubble ticks stay inert through normed blocks."""
+    xf = x.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * r * gain.astype(jnp.float32)).astype(x.dtype)
+
+
 def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
-    """One transformer block: ring attention + MoE FFN, both residual.
+    """One transformer block: attention + FFN (MoE or dense), both
+    residual, optionally pre-normed (``cfg.norm``).
 
     ``sub_params`` leaves are one stage's slice (no stage dim).
     ``x``: local shard ``[mb_loc, T_loc, Dm]``. Zero input → zero
     output, which keeps pipeline bubble ticks inert.
     """
-    q = jnp.einsum("btm,hmd->bhtd", x, sub_params["wq"])
-    k = jnp.einsum("btm,hmd->bhtd", x, sub_params["wk"])
-    v = jnp.einsum("btm,hmd->bhtd", x, sub_params["wv"])
+    h = _rms_norm(x, sub_params["ln1"]) if cfg.norm else x
+    q = jnp.einsum("btm,hmd->bhtd", h, sub_params["wq"])
+    k = jnp.einsum("btm,hmd->bhtd", h, sub_params["wk"])
+    v = jnp.einsum("btm,hmd->bhtd", h, sub_params["wv"])
     sp_size = jax.lax.axis_size(sp) if sp is not None else 1
     layout = "zigzag" if cfg.sp_strategy == "ring_zigzag" else "contiguous"
     if cfg.rope:
@@ -313,12 +357,28 @@ def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
     if tp is not None:
         y = jax.lax.psum(y, tp)  # Megatron join of head shards
     x = x + y
+    h2 = _rms_norm(x, sub_params["ln2"]) if cfg.norm else x
+    if cfg.dense_ffn:
+        return x + _dense_ffn(sub_params, h2, tp)
     # MoE FFN over flattened local tokens.
     moe_params = {k2: sub_params[k2] for k2 in ("router",)}
     moe_params["w1"], moe_params["w2"] = sub_params["we1"], sub_params["we2"]
-    tokens = x.reshape(-1, x.shape[-1])
+    tokens = h2.reshape(-1, h2.shape[-1])
     m_out = moe_layer_local(moe_params, tokens, cfg.moe(), ep_axis=ep)
     return x + m_out.reshape(x.shape)
+
+
+def _dense_ffn(sub_params: Params, h, tp):
+    """Dense 2-layer gelu MLP, Megatron-sharded over ``tp``: wf1 holds
+    a column (hidden) shard, wf2 the matching row shard, and one psum
+    joins the partial outputs. gelu(0) == 0 keeps bubbles inert."""
+    f_h = jax.nn.gelu(jnp.einsum("btm,mf->btf", h, sub_params["wf1"],
+                                 preferred_element_type=jnp.float32))
+    f_out = jnp.einsum("btf,fm->btm", f_h, sub_params["wf2"],
+                       preferred_element_type=jnp.float32)
+    if tp is not None:
+        f_out = jax.lax.psum(f_out, tp)
+    return f_out.astype(h.dtype)
 
 
 def _stage_block(stage_params: Params, x, cfg: FlagshipConfig,
@@ -464,7 +524,7 @@ def place_flagship_params_pipelined(params: Params, mesh: Mesh,
         )
     n = mesh.shape["pp"]
     s_chunk = cfg.stages // (n * chunks)
-    specs = flagship_param_specs(mesh)
+    specs = flagship_param_specs(mesh, cfg)
     return {k: jax.device_put(
                 jnp.asarray(to_device_major(np.asarray(v), n, chunks,
                                             s_chunk)),
@@ -560,7 +620,7 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
     s_chunk = cfg.stages // (n * chunks)
     sched = build_interleaved_schedule(cfg.microbatches, n, chunks)
     sp, tp, ep = axes.get("sp"), axes.get("tp"), axes.get("ep")
-    specs = flagship_param_specs(mesh)
+    specs = flagship_param_specs(mesh, cfg)
     n_out = cfg.batch * cfg.seq * cfg.model_dim
 
     def block_fn(chunk_params, x):
@@ -630,9 +690,12 @@ def _lm_logits_local(params, tokens, cfg: FlagshipConfig, axes):
     them on the replicated activations)."""
     x = jnp.take(params["emb"], tokens, axis=0).astype(jnp.dtype(cfg.dtype))
     # The stack sees only stage-major leaves: _stage_block slices every
-    # leaf by stage index, and emb's leading dim is the vocab.
-    stack = {k: v for k, v in params.items() if k != "emb"}
+    # leaf by stage index; emb (vocab-leading) and lnf (stage-less) are
+    # applied here around it.
+    stack = {k: v for k, v in params.items() if k not in ("emb", "lnf")}
     y = _forward_local(stack, x, cfg, axes)
+    if cfg.norm:
+        y = _rms_norm(y, params["lnf"])
     return jnp.einsum("btm,vm->btv", y.astype(jnp.float32),
                       params["emb"].astype(jnp.float32))
 
@@ -772,7 +835,9 @@ def init_optimizer(tx, params: Params):
 def place_flagship_params(params: Params, mesh: Mesh,
                           cfg: Optional[FlagshipConfig] = None) -> Params:
     specs = flagship_param_specs(mesh, cfg)
-    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+    base = _base_param_specs(mesh)  # covers the stage-less leaves
+    # (emb, lnf) when no cfg narrows the spec set
+    return {k: jax.device_put(v, NamedSharding(mesh, specs.get(k, base[k])))
             for k, v in params.items()}
 
 

@@ -125,6 +125,34 @@ def zigzag_chunks(rank, n: int, t_local: int):
     return rank * half, (2 * n - 1 - rank) * half
 
 
+def live_ring_hops(n: int, t: int, causal: bool, layout: str, window) -> int:
+    """Ring rotations that can carry a live KV block.
+
+    Contiguous causal layout with a sliding window: device ``my``'s
+    queries see only KV blocks ``my-H..my`` where
+    ``H = ceil((window-1)/T_local)`` — every later hop's block is
+    entirely behind the window (and wrap-around sources are entirely in
+    the future), so those rotations ship provably dead bytes and can be
+    dropped, not just compute-skipped. Zigzag holds a mirrored *late*
+    chunk on every rank, so all rotations stay live there. Shared by
+    the jnp ring and the flash ring (:mod:`tpu_p2p.ops.ring_flash`).
+    """
+    if window is not None and causal and layout == "contiguous":
+        return min(n - 1, -(-(window - 1) // t))
+    return n - 1
+
+
+def _check_window(window, causal: bool) -> None:
+    """Reject the silently-wrong windows: non-causal (undefined here)
+    and window < 1 (masks every key → all-zero attention)."""
+    if window is None:
+        return
+    if not causal:
+        raise ValueError("window requires causal attention")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+
+
 def _block_positions(src_block, n: int, t: int, layout: str):
     """Global positions ``[t]`` of a (possibly traced) block index."""
     if layout == "zigzag":
@@ -169,8 +197,7 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
     """
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
-    if window is not None and not causal:
-        raise ValueError("window requires causal attention")
+    _check_window(window, causal)
     if use_flash:
         from tpu_p2p.ops.ring_flash import ring_flash_attention
 
@@ -218,9 +245,7 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
         o2, m2, l2 = accumulate(o, m, l, k_nxt, v_nxt, src)
         return (o2, m2, l2, k_nxt, v_nxt), None
 
-    from tpu_p2p.ops.ring_flash import _live_hops
-
-    hops = _live_hops(n, t, causal, layout, window)
+    hops = live_ring_hops(n, t, causal, layout, window)
     if hops > 0:
         (o, m, l, _, _), _ = jax.lax.scan(
             hop, (o, m, l, k, v), jnp.arange(hops)

@@ -42,7 +42,13 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from tpu_p2p.ops.attention import NEG_INF, finalize, zigzag_chunks
+from tpu_p2p.ops.attention import (
+    NEG_INF,
+    _check_window,
+    finalize,
+    live_ring_hops as _live_hops,
+    zigzag_chunks,
+)
 from tpu_p2p.parallel.collectives import ring_edges as _ring_edges
 
 
@@ -51,22 +57,6 @@ def _halves(rank, n: int, t: int):
     half = t // 2
     lo, hi = zigzag_chunks(rank, n, t)
     return ((slice(0, half), lo), (slice(half, t), hi))
-
-
-def _live_hops(n: int, t: int, causal: bool, layout: str, window) -> int:
-    """Ring rotations that can carry a live KV block.
-
-    Contiguous causal layout with a sliding window: device ``my``'s
-    queries see only KV blocks ``my-H..my`` where
-    ``H = ceil((window-1)/T_local)`` — every later hop's block is
-    entirely behind the window (and wrap-around sources are entirely in
-    the future), so those rotations ship provably dead bytes and can be
-    dropped, not just compute-skipped. Zigzag holds a mirrored *late*
-    chunk on every rank, so all rotations stay live there.
-    """
-    if window is not None and causal and layout == "contiguous":
-        return min(n - 1, -(-(window - 1) // t))
-    return n - 1
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -116,8 +106,7 @@ def _accumulate(q, k_blk, v_blk, o, m, l, my, src, n, causal, layout,
 def _ring_flash_fwd(q, k, v, axis_name, causal, layout, window):
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
-    if window is not None and not causal:
-        raise ValueError("window requires causal attention")
+    _check_window(window, causal)
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, t, d = q.shape
