@@ -43,7 +43,7 @@ from tpu_p2p.models.flagship import (
     flagship_param_specs,
 )
 from tpu_p2p.models.moe import moe_layer_local
-from tpu_p2p.ops.attention import NEG_INF, repeat_kv
+from tpu_p2p.ops.attention import NEG_INF
 
 Cache = Dict[str, jax.Array]
 
@@ -119,20 +119,38 @@ def _decode_sub_block(sub, x, h, k_cache, v_cache, pos, cfg, tp, ep):
         from tpu_p2p.ops.rope import apply_rope
 
         q = apply_rope(q, jnp.reshape(pos, (1,)))
-    kw = repeat_kv(k_cache, q.shape[1])
-    vw = repeat_kv(v_cache, q.shape[1])
-    s = jnp.einsum("bhtd,bhTd->bhtT", q, kw,
+    b, hq = q.shape[0], q.shape[1]
+    w = cfg.attn_window
+    if w and w < max_len:
+        # Sliding window: read only the live band of the cache —
+        # decode is bandwidth-bound, so a static-size dynamic_slice
+        # cuts HBM traffic from O(max_len) to O(window) per step.
+        # The clip keeps the band in range near the sequence start
+        # (dynamic_slice would clamp identically, but the mask below
+        # needs the actual start).
+        start = jnp.clip(pos - w + 1, 0, max_len - w)
+        kb = jax.lax.dynamic_slice_in_dim(k_cache, start, w, axis=2)
+        vb = jax.lax.dynamic_slice_in_dim(v_cache, start, w, axis=2)
+        band_pos = start + jnp.arange(w)              # [w]
+        live = (band_pos <= pos) & (band_pos > pos - w)
+    else:
+        kb, vb = k_cache, v_cache
+        band_pos = jnp.arange(max_len)
+        live = band_pos <= pos
+        if w:
+            live &= band_pos > pos - w
+    # Grouped-query contraction straight against the narrow KV band —
+    # no materialized repeat_kv widening (group == 1 is plain MHA).
+    group = hq // kb.shape[1]
+    qg = q.reshape(b, kb.shape[1], group, 1, cfg.head_dim)
+    s = jnp.einsum("bkgtd,bkTd->bkgtT", qg, kb,
                    preferred_element_type=jnp.float32)
     s = s / (cfg.head_dim ** 0.5)
-    live = jnp.arange(max_len) <= pos                 # [max_len]
-    if cfg.attn_window:
-        # Sliding window: only the last attn_window positions stay
-        # live, matching the training forward's banded mask.
-        live &= jnp.arange(max_len) > pos - cfg.attn_window
-    s = jnp.where(live[None, None, None, :], s, NEG_INF)
+    s = jnp.where(live[None, None, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-    a = jnp.einsum("bhtT,bhTd->bhtd", p, vw,
+    a = jnp.einsum("bkgtT,bkTd->bkgtd", p, vb,
                    preferred_element_type=jnp.float32).astype(x.dtype)
+    a = a.reshape(b, hq, 1, cfg.head_dim)
     y = jnp.einsum("bhtd,hdm->btm", a, sub["wo"])
     if tp is not None:
         y = jax.lax.psum(y, tp)

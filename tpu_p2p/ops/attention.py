@@ -125,6 +125,26 @@ def zigzag_chunks(rank, n: int, t_local: int):
     return rank * half, (2 * n - 1 - rank) * half
 
 
+def _vma_of(*arrays) -> frozenset:
+    return frozenset().union(
+        *(getattr(jax.typeof(a), "vma", frozenset()) for a in arrays)
+    )
+
+
+def _union_vma(*arrays):
+    """(union varying-mesh-axes set, arrays each pcast up to it) — the
+    one place fresh (unvarying) accumulators get promoted before a
+    shard_map scan whose body produces varying outputs."""
+    vma = _vma_of(*arrays)
+    out = []
+    for a in arrays:
+        missing = vma - getattr(jax.typeof(a), "vma", frozenset())
+        out.append(
+            jax.lax.pcast(a, tuple(missing), to="varying") if missing else a
+        )
+    return vma, out
+
+
 def live_ring_hops(n: int, t: int, causal: bool, layout: str, window) -> int:
     """Ring rotations that can carry a live KV block.
 
@@ -216,6 +236,9 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
     o = jnp.zeros((b, h, t, d), jnp.float32)
     m = jnp.full((b, h, t), NEG_INF, jnp.float32)
     l = jnp.zeros((b, h, t), jnp.float32)
+    # Fresh accumulators are unvarying; the scan body's outputs vary —
+    # promote before the carry loop (no-op when vma checking is off).
+    _, (o, m, l, q, k, v) = _union_vma(o, m, l, q, k, v)
 
     q_pos = _block_positions(my, n, t, layout)  # global query positions
 
@@ -233,23 +256,27 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
                        src_block)
         return _merge(o, m, l, s, repeat_kv(v_blk, h))
 
-    # Local block first (no hop needed)…
-    o, m, l = accumulate(o, m, l, k, v, my)
-
-    # …then n-1 rotate-and-accumulate hops.
     def hop(carry, i):
         o, m, l, k_cur, v_cur = carry
+        # Prefetch the next block WHILE computing on the current one —
+        # the permute output is not consumed by this body's compute, so
+        # XLA's async collective-permute overlaps transfer with math
+        # (same structure as tpu_p2p.ops.ring_flash).
         k_nxt = jax.lax.ppermute(k_cur, axis_name, edges)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, edges)
-        src = jax.lax.rem(my - i - 1 + n + n, n)  # block now held locally
-        o2, m2, l2 = accumulate(o, m, l, k_nxt, v_nxt, src)
+        src = jax.lax.rem(my - i + n + n, n)  # block currently held
+        o2, m2, l2 = accumulate(o, m, l, k_cur, v_cur, src)
         return (o2, m2, l2, k_nxt, v_nxt), None
 
     hops = live_ring_hops(n, t, causal, layout, window)
+    k_last, v_last, last_src = k, v, my
     if hops > 0:
-        (o, m, l, _, _), _ = jax.lax.scan(
+        (o, m, l, k_last, v_last), _ = jax.lax.scan(
             hop, (o, m, l, k, v), jnp.arange(hops)
         )
+        last_src = jax.lax.rem(my - hops + n + n, n)
+    # Final (or only) block: compute without shipping anything further.
+    o, m, l = accumulate(o, m, l, k_last, v_last, last_src)
 
     # Fully-masked rows (can't happen for causal ring queries, but keep
     # the kernel total): finalize guards l == 0.

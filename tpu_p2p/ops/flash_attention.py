@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from tpu_p2p.ops.attention import NEG_INF
+from tpu_p2p.ops.attention import NEG_INF, _union_vma, _vma_of
 
 
 def _interpret_default() -> bool:
@@ -157,18 +157,6 @@ def _kernel(offs_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
         l_ref[0] = l * alpha + p.sum(axis=-1, keepdims=True)
 
 
-def _union_vma(*arrays):
-    """(union varying-mesh-axes set, arrays each pcast up to it)."""
-    vma = _vma_of(*arrays)
-    out = []
-    for a in arrays:
-        missing = vma - getattr(jax.typeof(a), "vma", frozenset())
-        out.append(
-            jax.lax.pcast(a, tuple(missing), to="varying") if missing else a
-        )
-    return vma, out
-
-
 def _gqa_group(bh_q: int, bh_kv: int, q_heads: int) -> int:
     """Derive and validate the GQA group size from flattened row counts
     (``B·H_q``, ``B·H_kv``) and the per-batch query head count. Raises
@@ -253,12 +241,6 @@ def _flash_call_jax(q3, k3, v3, o0, m0, l0, q_off, k_off, *,
         o0 * alpha[..., None] + pv,
         m_new,
         l0 * alpha + p.sum(axis=-1),
-    )
-
-
-def _vma_of(*arrays) -> frozenset:
-    return frozenset().union(
-        *(getattr(jax.typeof(a), "vma", frozenset()) for a in arrays)
     )
 
 

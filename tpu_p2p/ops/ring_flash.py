@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from tpu_p2p.ops.attention import (
     NEG_INF,
     _check_window,
+    _union_vma,
     finalize,
     live_ring_hops as _live_hops,
     zigzag_chunks,
@@ -115,25 +116,34 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, layout, window):
     o = jnp.zeros((b, h, t, d), jnp.float32)
     m = jnp.full((b, h, t), NEG_INF, jnp.float32)
     l = jnp.zeros((b, h, t), jnp.float32)
+    # Fresh accumulators must carry the union vma before the scan
+    # under a vma-checked shard_map (same promotion as the backward).
+    _, (o, m, l, q, k, v) = _union_vma(o, m, l, q, k, v)
     edges = _ring_edges(n)
-
-    o, m, l = _accumulate(q, k, v, o, m, l, my, my, n, causal, layout,
-                          window)
 
     def hop(carry, i):
         o, m, l, k_cur, v_cur = carry
+        # Prefetch the next block WHILE computing on the current one:
+        # the permute's output is not consumed by this body's compute,
+        # so XLA's async collective-permute overlaps the hop transfer
+        # with the kernel (a permute→compute chain would serialize).
         k_nxt = jax.lax.ppermute(k_cur, axis_name, edges)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, edges)
-        src = jax.lax.rem(my - i - 1 + n + n, n)
-        o2, m2, l2 = _accumulate(q, k_nxt, v_nxt, o, m, l, my, src,
+        src = jax.lax.rem(my - i + n + n, n)
+        o2, m2, l2 = _accumulate(q, k_cur, v_cur, o, m, l, my, src,
                                  n, causal, layout, window)
         return (o2, m2, l2, k_nxt, v_nxt), None
 
     hops = _live_hops(n, t, causal, layout, window)
+    k_last, v_last, last_src = k, v, my
     if hops > 0:
-        (o, m, l, _, _), _ = jax.lax.scan(
+        (o, m, l, k_last, v_last), _ = jax.lax.scan(
             hop, (o, m, l, k, v), jnp.arange(hops)
         )
+        last_src = jax.lax.rem(my - hops + n + n, n)
+    # Final (or only) block: compute without shipping anything further.
+    o, m, l = _accumulate(q, k_last, v_last, o, m, l, my, last_src,
+                          n, causal, layout, window)
     out = finalize(o, m, l, q.dtype)
     # Logsumexp residual for the backward; fully-masked rows (l == 0,
     # impossible for causal ring queries but kept total) get +1e30 so
@@ -185,8 +195,6 @@ def _ring_flash_bwd(axis_name, causal, layout, window, res, g):
     # Under a vma-checked shard_map the fresh zero accumulators are
     # unvarying while the scan body's outputs vary — promote them (and
     # anything else lagging) to the union before the carry loop.
-    from tpu_p2p.ops.flash_attention import _union_vma
-
     _, (dq, dka, dva, q, k, v, g, L, delta) = _union_vma(
         dq, dka, dva, q, k, v, g, L, delta
     )
