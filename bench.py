@@ -125,6 +125,66 @@ def _flagship_step_metrics(timing):
     }
 
 
+def _decode_metrics(timing):
+    """KV-cached decode tokens/s at a bf16 single-chip config with a
+    4k cache and a 1k sliding window (the banded-read fast path) —
+    the inference-side number complementing the train-step metric.
+    Differential like everything here: a scan of N decode steps inside
+    one program, slope between two lengths."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_p2p.models import decode as D
+    from tpu_p2p.models import flagship as F
+
+    mesh = F.build_mesh(1, devices=jax.devices()[:1])
+    max_len = 4096
+    cfg = F.FlagshipConfig(
+        batch=8, seq=1024, heads=8, kv_heads=2, head_dim=64, stages=2,
+        microbatches=1, num_experts=4, dtype="bfloat16", norm=True,
+        rope=True, attn_window=1024,
+    )
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    step = D.make_flagship_decode_step(mesh, cfg)
+    x0 = jnp.zeros((cfg.batch, 1, cfg.model_dim), jnp.bfloat16)
+
+    def make_chain(n):
+        @jax.jit
+        def f(x0):
+            cache = {
+                k: jnp.zeros((cfg.stages, cfg.batch, cfg.num_kv_heads,
+                              max_len, cfg.head_dim), jnp.bfloat16)
+                for k in ("k", "v")
+            }
+
+            def body(carry, _):
+                cache, x = carry
+                # Fixed worst-case position: a fresh compile per
+                # traced pos is avoided by the scan, and max_len-1
+                # keeps the banded read at full window depth.
+                cache, y = step(params, cache, x, max_len - 1)
+                return (cache, y), ()
+
+            (_, x), _ = jax.lax.scan(body, (cache, x0), None, length=n)
+            return x
+
+        return f
+
+    # Long chains + extra repeats: one decode step is only ~70 µs, so
+    # a 48-step chain is ~3 ms — thin enough for relay jitter to flip
+    # the two-length slope negative. 256 steps puts the chain delta
+    # well above the jitter floor.
+    s = timing.measure_differential(make_chain, x0, 256, repeats=4)
+    if not (s.mean_region > 0):
+        # Raise like _flagship_step_metrics: main() catches and logs,
+        # so a null decode number is explained in stderr.
+        raise RuntimeError("decode differential slope was not positive")
+    return {
+        "decode_ms_per_token": round(s.mean_region * 1e3, 3),
+        "decode_tokens_per_s": round(cfg.batch / s.mean_region),
+    }
+
+
 def main() -> int:
     import numpy as np
 
@@ -224,6 +284,12 @@ def main() -> int:
             # Explicit nulls keep the JSON schema stable across runs.
             flagship = {"flagship_step_ms": None,
                         "flagship_tokens_per_s": None}
+        try:
+            decode = _decode_metrics(timing)
+        except Exception as e:  # noqa: BLE001 — same rationale
+            print(f"# decode measurement failed: {e!r}", file=sys.stderr)
+            decode = {"decode_ms_per_token": None,
+                      "decode_tokens_per_s": None}
         result = {
             "metric": "loopback_hbm_rewrite_bandwidth",
             "value": round(float(value), 3),
@@ -241,6 +307,7 @@ def main() -> int:
                 "per_op_floor_us": round(s8.mean_region * 1e6, 2),
                 "flash_attention_tflops": flash_tflops,
                 **flagship,
+                **decode,
                 "mode": "differential",
                 "block_fence_trustworthy": fence_ok,
             },

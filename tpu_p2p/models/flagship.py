@@ -104,6 +104,11 @@ class FlagshipConfig:
     # gelu MLP (wf1/wf2), Megatron-sharded over tp (wf1 column-split,
     # wf2 row-split, one psum join). num_experts/capacity_factor/ep are
     # then unused — the ep mesh axis still shards data.
+    remat: bool = False      # rematerialize each transformer sub-block
+    # in the backward (jax.checkpoint): activation memory drops from
+    # O(layers) full-block residuals to O(layers) block inputs, the
+    # block recomputes in the bwd — the standard long-sequence
+    # FLOPs-for-HBM trade. Gradients are bit-identical either way.
     attn_window: int = 0     # > 0: sliding-window (local) attention —
     # each position attends to its last `attn_window` positions. Needs
     # causal=True; works under every sp_strategy (ring paths window
@@ -384,9 +389,15 @@ def _dense_ffn(sub_params: Params, h, tp):
 def _stage_block(stage_params: Params, x, cfg: FlagshipConfig,
                  s_local: int, sp, tp, ep):
     """Apply this pp rank's ``s_local`` consecutive sub-blocks."""
+    body = _stage_sub_block
+    if cfg.remat:
+        # Per-block rematerialization: save only each block's input,
+        # recompute the block inside the backward.
+        body = jax.checkpoint(_stage_sub_block,
+                              static_argnums=(2, 3, 4, 5))
     for i in range(s_local):
         sub = {k: v[i] for k, v in stage_params.items()}
-        x = _stage_sub_block(sub, x, cfg, sp, tp, ep)
+        x = body(sub, x, cfg, sp, tp, ep)
     return x
 
 
@@ -493,17 +504,23 @@ def make_flagship_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
 
 
 def make_flagship_train_step(mesh: Mesh, cfg: FlagshipConfig,
-                             lr: float = 1e-2):
-    """One jitted SGD step: forward, backward, update."""
+                             lr: float = 1e-2, donate: bool = False):
+    """One jitted SGD step: forward, backward, update.
+
+    ``donate=True`` donates the incoming params to the step so XLA
+    updates them in place (halves param HBM traffic and peak param
+    memory) — the caller must then treat the passed params as consumed
+    (``params, loss = step(params, ...)``) and never reuse the old
+    reference, so it is opt-in.
+    """
     grad_fn = make_flagship_grad_fn(mesh, cfg)
     n_out = cfg.batch * cfg.seq * cfg.model_dim
 
-    @jax.jit
     def step(params, x, target):
         grads, loss = grad_fn(params, x, target)
         return _sgd_update(params, grads, lr, n_out), loss / n_out
 
-    return step
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
 def place_flagship_params_pipelined(params: Params, mesh: Mesh,
