@@ -1,0 +1,118 @@
+"""tpu_p2p.train: training loop, JSONL logging, checkpoint/resume —
+including bit-exact resume continuity (the per-step-seeded batch
+stream makes interrupted+resumed == uninterrupted)."""
+
+import io
+import json
+import os
+
+import numpy as np
+
+from tpu_p2p.models import flagship as F
+from tpu_p2p.train import run_training
+
+
+def _cfg(**kw):
+    base = dict(batch=8, seq=32, heads=4, head_dim=8, stages=2,
+                microbatches=2, num_experts=2, capacity_factor=4.0,
+                norm=True)
+    base.update(kw)
+    return F.FlagshipConfig(**base)
+
+
+def test_training_runs_and_logs(tmp_path):
+    mesh = F.build_mesh(8)
+    cfg = _cfg()
+    log = tmp_path / "log.jsonl"
+    out = run_training(mesh, cfg, steps=6, lr=5e-2, log_every=2,
+                       log_path=str(log))
+    assert out["steps_run"] == 6 and out["start_step"] == 0
+    recs = [json.loads(line) for line in log.read_text().splitlines()]
+    assert [r["step"] for r in recs] == [2, 4, 6]
+    assert all(np.isfinite(r["loss"]) for r in recs)
+    assert recs[-1]["loss"] < recs[0]["loss"]
+    assert out["final_loss"] == recs[-1]["loss"]
+
+
+def test_resume_is_bit_exact(tmp_path):
+    mesh = F.build_mesh(8)
+    cfg = _cfg()
+    ck_a = str(tmp_path / "interrupted")
+    # Uninterrupted 6-step run…
+    full = run_training(mesh, cfg, steps=6, lr=5e-2, log_every=6)
+    # …vs 4 steps, "crash", resume for the last 2.
+    run_training(mesh, cfg, steps=4, lr=5e-2, log_every=0,
+                 ckpt_dir=ck_a, ckpt_every=2)
+    resumed = run_training(mesh, cfg, steps=6, lr=5e-2, log_every=6,
+                           ckpt_dir=ck_a, resume=True)
+    assert resumed["start_step"] == 4 and resumed["steps_run"] == 2
+    np.testing.assert_allclose(resumed["final_loss"], full["final_loss"],
+                               rtol=1e-6)
+    for k in full["params"]:
+        np.testing.assert_array_equal(np.asarray(resumed["params"][k]),
+                                      np.asarray(full["params"][k]),
+                                      err_msg=k)
+
+
+def test_resume_past_end_is_noop(tmp_path):
+    mesh = F.build_mesh(8)
+    cfg = _cfg()
+    ck = str(tmp_path / "done")
+    run_training(mesh, cfg, steps=3, lr=5e-2, log_every=0,
+                 ckpt_dir=ck, ckpt_every=3)
+    out = run_training(mesh, cfg, steps=3, lr=5e-2, log_every=0,
+                       ckpt_dir=ck, resume=True)
+    assert out["steps_run"] == 0 and out["start_step"] == 3
+
+
+def test_mismatched_checkpoint_rejected(tmp_path):
+    mesh = F.build_mesh(8)
+    ck = str(tmp_path / "moe")
+    out = run_training(mesh, _cfg(), steps=2, lr=5e-2, log_every=0,
+                       ckpt_dir=ck, ckpt_every=2)
+    # log_every=0 must still report the final loss (loss tracking is
+    # not gated on the logging cadence).
+    assert np.isfinite(out["final_loss"])
+    import pytest
+
+    # Different param set (dense vs MoE)…
+    with pytest.raises(ValueError, match="mismatch"):
+        run_training(mesh, _cfg(dense_ffn=True), steps=4, lr=5e-2,
+                     log_every=0, ckpt_dir=ck, resume=True)
+    # …same keys but drifted shape (heads 4 -> 8)…
+    with pytest.raises(ValueError, match="shape"):
+        run_training(mesh, _cfg(heads=8), steps=4, lr=5e-2,
+                     log_every=0, ckpt_dir=ck, resume=True)
+    # …and same shapes but drifted dtype (f32 checkpoint, bf16 config).
+    with pytest.raises(ValueError, match="dtype"):
+        run_training(mesh, _cfg(dtype="bfloat16"), steps=4, lr=5e-2,
+                     log_every=0, ckpt_dir=ck, resume=True)
+
+
+def test_lm_training_via_trainer(tmp_path):
+    mesh = F.build_mesh(8)
+    cfg = _cfg(vocab=64)
+    stream = io.StringIO()
+    out = run_training(mesh, cfg, steps=4, lr=5e-2, log_every=2,
+                       log_stream=stream)
+    assert out["steps_run"] == 4
+    recs = [json.loads(line) for line in stream.getvalue().splitlines()]
+    assert recs[-1]["loss"] < np.log(cfg.vocab) + 1  # near ln V from init
+    assert np.isfinite(out["final_loss"])
+
+
+def test_cli_entry(tmp_path):
+    # The module-level CLI on the simulated mesh (in-process: the
+    # conftest already pinned the platform; --cpu-mesh just adds the
+    # device-count flag, which is already set to 8).
+    from tpu_p2p import train as T
+
+    rc = T.main([
+        "--steps", "2", "--log-every", "1", "--batch", "8", "--seq", "16",
+        "--heads", "4", "--head-dim", "8", "--stages", "2",
+        "--microbatches", "2", "--experts", "2", "--cpu-mesh", "8",
+        "--log-jsonl", str(tmp_path / "cli.jsonl"),
+    ])
+    assert rc == 0
+    lines = (tmp_path / "cli.jsonl").read_text().splitlines()
+    assert len(lines) == 2

@@ -742,12 +742,14 @@ def make_flagship_lm_forward(mesh: Mesh, cfg: FlagshipConfig):
 
 
 def make_flagship_lm_train_step(mesh: Mesh, cfg: FlagshipConfig,
-                                lr: float = 1e-2):
+                                lr: float = 1e-2, donate: bool = False):
     """One jitted SGD step on next-token cross-entropy.
 
     ``(params, tokens [B, T], targets [B, T]) → (params, mean CE)``
     (the caller shifts targets). Gradient reductions are implicit in
-    shard_map autodiff, exactly as in the regression step.
+    shard_map autodiff, exactly as in the regression step. ``donate``
+    as in :func:`make_flagship_train_step` (params updated in place;
+    callers must reassign).
     """
     from tpu_p2p.parallel import fsdp
 
@@ -779,7 +781,7 @@ def make_flagship_lm_train_step(mesh: Mesh, cfg: FlagshipConfig,
         in_specs=(specs, tok_spec, tok_spec),
         out_specs=(specs, P()),
     )
-    return jax.jit(sm)
+    return jax.jit(sm, donate_argnums=(0,) if donate else ())
 
 
 def flagship_token_batch(cfg: FlagshipConfig, mesh: Mesh = None,

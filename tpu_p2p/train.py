@@ -1,0 +1,244 @@
+"""Config-driven training runs: loop + checkpoint/resume + JSONL log.
+
+``python -m tpu_p2p.train --steps 200 --ckpt-dir runs/a --ckpt-every 50``
+
+The reference has no training at all (it is a transport benchmark,
+``/root/reference/p2p_matrix.cc``); this module is the user-facing
+assembly of the framework's model layer — the flagship step
+(:mod:`tpu_p2p.models.flagship`), the prefetching device loader
+(:mod:`tpu_p2p.utils.data`), and model checkpointing
+(:mod:`tpu_p2p.utils.checkpoint`) — mirroring, at the model level, the
+benchmark side's per-cell JSONL + ``--resume`` story (SURVEY.md §5
+"checkpoint / resume").
+
+Mechanics worth knowing:
+
+- **Deterministic resume.** Batches are generated per-step from
+  ``seed`` and the *global* step index, so a resumed run consumes
+  exactly the batches the interrupted run would have — a 6-step run
+  checkpointed at 4 and resumed for 2 reproduces the uninterrupted
+  6-step run bit-for-bit (pinned in tests/test_trainer.py).
+- **One rolling checkpoint.** ``--ckpt-every N`` overwrites
+  ``ckpt_dir`` each time (params + step metadata); ``--resume`` picks
+  it up and continues from the recorded step. Cross-mesh resume works
+  (restore is a ``device_put`` under the target mesh's specs).
+- **Donated params.** The loop reassigns ``params`` every step, so the
+  step is built with ``donate=True`` and XLA updates in place.
+- **Wall-clock tokens/s.** The JSONL log reports wall-clock rates
+  (host loop + dispatch included); device-side step time is
+  ``bench.py``'s job (differential chains through the relay).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+def _per_step_batches(cfg, seed: int, start_step: int) -> Iterator:
+    """Host batches keyed by (seed, global step) — resumable exactly."""
+    from tpu_p2p.models.flagship import flagship_host_batch
+
+    step = start_step
+    while True:
+        rng = np.random.default_rng((seed + 1) * 1_000_003 + step)
+        if cfg.vocab:
+            toks = rng.integers(0, cfg.vocab, (cfg.batch, cfg.seq + 1))
+            toks = toks.astype(np.int32)
+            yield toks[:, :-1], toks[:, 1:]
+        else:
+            yield flagship_host_batch(cfg, rng)
+        step += 1
+
+
+def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
+                 seed: int = 0, log_every: int = 10,
+                 ckpt_dir: Optional[str] = None, ckpt_every: int = 0,
+                 resume: bool = False, log_path: Optional[str] = None,
+                 log_stream=None) -> dict:
+    """Train the flagship for ``steps`` global steps; returns a summary
+    dict (``final_loss``, ``steps_run``, ``start_step``, ...).
+
+    ``resume=True`` with a checkpoint in ``ckpt_dir`` continues from
+    its recorded step (no-op if already past ``steps``).
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.utils import checkpoint as C
+    from tpu_p2p.utils.data import DeviceLoader
+
+    start_step = 0
+    specs = F.flagship_param_specs(mesh, cfg)
+    if resume and ckpt_dir and os.path.exists(
+        os.path.join(ckpt_dir, "params.npz")
+    ):
+        # Load host-side first: key validation must precede placement
+        # (placing looks specs up per checkpoint key and would KeyError
+        # confusingly on a config/checkpoint mismatch).
+        host, start_step = C.load_params(ckpt_dir)
+        want_shapes = F.flagship_param_shapes(cfg)
+        want_dtype = np.dtype(cfg.dtype)
+        problems = []
+        if set(host) != set(specs):
+            problems.append(
+                f"keys {sorted(host)} vs expected {sorted(specs)}"
+            )
+        else:
+            for k, v in host.items():
+                if tuple(v.shape) != tuple(want_shapes[k]):
+                    problems.append(
+                        f"{k}: shape {v.shape} vs expected {want_shapes[k]}"
+                    )
+                elif v.dtype != want_dtype:
+                    # device_put does not cast — a dtype drift would
+                    # silently train in the checkpoint's dtype.
+                    problems.append(
+                        f"{k}: dtype {v.dtype} vs expected {want_dtype}"
+                    )
+        if problems:
+            raise ValueError(
+                f"checkpoint at {ckpt_dir} does not fit this config "
+                f"(config/checkpoint mismatch): {'; '.join(problems)}"
+            )
+        params = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+                  for k, v in host.items()}
+    else:
+        params = F.place_flagship_params(
+            F.init_flagship_params(cfg, seed=seed), mesh, cfg
+        )
+
+    if cfg.vocab:
+        step_fn = F.make_flagship_lm_train_step(mesh, cfg, lr=lr,
+                                                donate=True)
+        data_spec = F._lm_token_spec(mesh)
+    else:
+        step_fn = F.make_flagship_train_step(mesh, cfg, lr=lr, donate=True)
+        data_spec = F.flagship_data_spec(mesh)
+
+    loader = DeviceLoader(_per_step_batches(cfg, seed, start_step), mesh,
+                          data_spec, prefetch=2)
+
+    def emit(rec):
+        line = json.dumps(rec)
+        if log_stream is not None:
+            print(line, file=log_stream, flush=True)
+        if log_path:
+            with open(log_path, "a") as fh:
+                fh.write(line + "\n")
+
+    t0 = time.monotonic()
+    tokens_per_step = cfg.batch * cfg.seq
+    loss = None
+    saved_at = start_step - 1
+    for step in range(start_step, steps):
+        x, t = next(loader)
+        params, loss = step_fn(params, x, t)
+        if log_every and ((step + 1) % log_every == 0 or step + 1 == steps):
+            dt = time.monotonic() - t0
+            emit({
+                "step": step + 1,
+                "loss": round(float(loss), 6),  # device sync on log steps
+                "wall_s": round(dt, 3),
+                "tokens_per_s_wall": round(
+                    (step + 1 - start_step) * tokens_per_step / dt
+                ),
+            })
+        if ckpt_every and ckpt_dir and (step + 1) % ckpt_every == 0:
+            C.save_params(ckpt_dir, params, step=step + 1)
+            saved_at = step + 1
+    ran = max(0, steps - start_step)
+    if ran and ckpt_dir and saved_at != steps:  # rolling save may have
+        # already written this exact state — don't gather it twice
+        C.save_params(ckpt_dir, params, step=steps)
+    final = round(float(loss), 6) if loss is not None else None
+    return {
+        "start_step": start_step,
+        "steps_run": ran,
+        "final_loss": final,
+        "params": params,
+    }
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_p2p.train",
+        description="Train the flagship model (synthetic data) with "
+                    "checkpoint/resume and JSONL logging.",
+    )
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--log-jsonl", default=None, metavar="PATH")
+    p.add_argument("--ckpt-dir", default=None, metavar="DIR")
+    p.add_argument("--ckpt-every", type=int, default=0, metavar="N")
+    p.add_argument("--resume", action="store_true",
+                   help="continue from the checkpoint in --ckpt-dir")
+    p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
+                   help="testing: force CPU platform with N simulated devices")
+    # Model shape (FlagshipConfig fields).
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=256)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=0)
+    p.add_argument("--head-dim", type=int, default=32)
+    p.add_argument("--stages", type=int, default=2)
+    p.add_argument("--microbatches", type=int, default=2)
+    p.add_argument("--experts", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=0)
+    p.add_argument("--attn-window", type=int, default=0)
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--sp-strategy", default="ring",
+                   choices=("ring", "ring_zigzag", "ulysses"))
+    for flag in ("flash", "norm", "dense-ffn", "rope", "remat", "zero-dp"):
+        p.add_argument(f"--{flag}", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.cpu_mesh:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.cpu_mesh}"
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from tpu_p2p.models import flagship as F
+
+    n = args.cpu_mesh or len(jax.devices())
+    mesh = F.build_mesh(n)
+    cfg = F.FlagshipConfig(
+        batch=args.batch, seq=args.seq, heads=args.heads,
+        kv_heads=args.kv_heads, head_dim=args.head_dim,
+        stages=args.stages, microbatches=args.microbatches,
+        num_experts=args.experts, vocab=args.vocab,
+        attn_window=args.attn_window, dtype=args.dtype,
+        sp_strategy=args.sp_strategy, use_flash=args.flash,
+        norm=args.norm, dense_ffn=args.dense_ffn, rope=args.rope,
+        remat=args.remat, zero_dp=args.zero_dp,
+    )
+    summary = run_training(
+        mesh, cfg, steps=args.steps, lr=args.lr, seed=args.seed,
+        log_every=args.log_every, ckpt_dir=args.ckpt_dir,
+        ckpt_every=args.ckpt_every, resume=args.resume,
+        log_path=args.log_jsonl, log_stream=sys.stdout,
+    )
+    summary.pop("params")
+    print(json.dumps({"summary": summary}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
