@@ -741,16 +741,10 @@ def make_flagship_lm_forward(mesh: Mesh, cfg: FlagshipConfig):
     return jax.jit(sm)
 
 
-def make_flagship_lm_train_step(mesh: Mesh, cfg: FlagshipConfig,
-                                lr: float = 1e-2, donate: bool = False):
-    """One jitted SGD step on next-token cross-entropy.
-
-    ``(params, tokens [B, T], targets [B, T]) → (params, mean CE)``
-    (the caller shifts targets). Gradient reductions are implicit in
-    shard_map autodiff, exactly as in the regression step. ``donate``
-    as in :func:`make_flagship_train_step` (params updated in place;
-    callers must reassign).
-    """
+def make_flagship_lm_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
+    """Jitted ``(params, tokens, targets) → (grads, summed CE)`` —
+    the LM twin of :func:`make_flagship_grad_fn` (same contract: raw
+    global-sum loss and grads; step builders own the normalization)."""
     from tpu_p2p.parallel import fsdp
 
     if not cfg.vocab:
@@ -758,7 +752,6 @@ def make_flagship_lm_train_step(mesh: Mesh, cfg: FlagshipConfig,
     axes = _mesh_axes(mesh)
     plan = _fsdp_plan(mesh, cfg)
     specs = flagship_param_specs(mesh, cfg)
-    n_tok = cfg.batch * cfg.seq
 
     def gstep(params, tokens, targets):
         def local_loss(p):
@@ -773,7 +766,7 @@ def make_flagship_lm_train_step(mesh: Mesh, cfg: FlagshipConfig,
         data_axes = _data_axes(axes)
         if data_axes:
             loss = jax.lax.psum(loss, data_axes)
-        return _sgd_update(params, grads, lr, n_tok), loss / n_tok
+        return grads, loss
 
     tok_spec = _lm_token_spec(mesh)
     sm = jax.shard_map(
@@ -781,7 +774,27 @@ def make_flagship_lm_train_step(mesh: Mesh, cfg: FlagshipConfig,
         in_specs=(specs, tok_spec, tok_spec),
         out_specs=(specs, P()),
     )
-    return jax.jit(sm, donate_argnums=(0,) if donate else ())
+    return jax.jit(sm)
+
+
+def make_flagship_lm_train_step(mesh: Mesh, cfg: FlagshipConfig,
+                                lr: float = 1e-2, donate: bool = False):
+    """One jitted SGD step on next-token cross-entropy.
+
+    ``(params, tokens [B, T], targets [B, T]) → (params, mean CE)``
+    (the caller shifts targets). Gradient reductions are implicit in
+    shard_map autodiff, exactly as in the regression step. ``donate``
+    as in :func:`make_flagship_train_step` (params updated in place;
+    callers must reassign).
+    """
+    grad_fn = make_flagship_lm_grad_fn(mesh, cfg)
+    n_tok = cfg.batch * cfg.seq
+
+    def step(params, tokens, targets):
+        grads, loss = grad_fn(params, tokens, targets)
+        return _sgd_update(params, grads, lr, n_tok), loss / n_tok
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
 def flagship_token_batch(cfg: FlagshipConfig, mesh: Mesh = None,
@@ -797,21 +810,27 @@ def flagship_token_batch(cfg: FlagshipConfig, mesh: Mesh = None,
     return x, t
 
 
-def make_flagship_optax_step(mesh: Mesh, cfg: FlagshipConfig, tx):
+def make_flagship_optax_step(mesh: Mesh, cfg: FlagshipConfig, tx,
+                             lm: bool = False, donate: bool = False):
     """One jitted step under any optax ``GradientTransformation``.
 
     ``(params, opt_state, x, target) → (params, opt_state, loss)``.
     The optimizer math is plain elementwise jit outside the shard_map:
     XLA propagates the param/grad shardings into the update, so mu/nu
     moments shard exactly like their params. Initialize with
-    :func:`init_optimizer`.
+    :func:`init_optimizer`. ``lm=True`` trains next-token CE on token
+    batches (``cfg.vocab > 0``); ``donate`` donates params AND opt
+    state (callers must reassign both).
     """
     import optax
 
-    grad_fn = make_flagship_grad_fn(mesh, cfg)
-    n_out = cfg.batch * cfg.seq * cfg.model_dim
+    if lm:
+        grad_fn = make_flagship_lm_grad_fn(mesh, cfg)
+        n_out = cfg.batch * cfg.seq
+    else:
+        grad_fn = make_flagship_grad_fn(mesh, cfg)
+        n_out = cfg.batch * cfg.seq * cfg.model_dim
 
-    @jax.jit
     def step(params, opt_state, x, target):
         grads, loss = grad_fn(params, x, target)
         grads = jax.tree.map(lambda g: g / n_out, grads)
@@ -819,7 +838,7 @@ def make_flagship_optax_step(mesh: Mesh, cfg: FlagshipConfig, tx):
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss / n_out
 
-    return step
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
 
 def init_optimizer(tx, params: Params):

@@ -57,16 +57,53 @@ def _per_step_batches(cfg, seed: int, start_step: int) -> Iterator:
         step += 1
 
 
+def _make_eval_fn(mesh, cfg):
+    """Jitted mean eval loss ``(params, x, t) → scalar`` matching the
+    train objective (MSE/elem or CE/token), no update."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_p2p.models import flagship as F
+
+    if cfg.vocab:
+        fwd = F.make_flagship_lm_forward(mesh, cfg)
+
+        @jax.jit
+        def eval_fn(params, toks, targets):
+            logp = jax.nn.log_softmax(fwd(params, toks), axis=-1)
+            nll = -jnp.take_along_axis(logp, targets[..., None],
+                                       axis=-1)[..., 0]
+            return jnp.mean(nll)
+    else:
+        fwd = F.make_flagship_forward(mesh, cfg)
+
+        @jax.jit
+        def eval_fn(params, x, t):
+            out = fwd(params, x)
+            return jnp.mean(
+                (out.astype(jnp.float32) - t.astype(jnp.float32)) ** 2
+            )
+
+    return eval_fn
+
+
 def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
                  seed: int = 0, log_every: int = 10,
                  ckpt_dir: Optional[str] = None, ckpt_every: int = 0,
                  resume: bool = False, log_path: Optional[str] = None,
-                 log_stream=None) -> dict:
+                 log_stream=None, optimizer: str = "sgd",
+                 weight_decay: float = 0.0, eval_every: int = 0,
+                 eval_batches: int = 2) -> dict:
     """Train the flagship for ``steps`` global steps; returns a summary
     dict (``final_loss``, ``steps_run``, ``start_step``, ...).
 
     ``resume=True`` with a checkpoint in ``ckpt_dir`` continues from
     its recorded step (no-op if already past ``steps``).
+    ``optimizer="adamw"`` trains with optax AdamW; its moments are
+    checkpointed alongside the params and restored on resume.
+    ``eval_every=N`` evaluates the loss on a fixed held-out batch set
+    (a disjoint seed stream) every N steps, emitting ``eval_loss``
+    records to the same log.
     """
     import jax
     from jax.sharding import NamedSharding
@@ -115,13 +152,50 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
             F.init_flagship_params(cfg, seed=seed), mesh, cfg
         )
 
-    if cfg.vocab:
+    if optimizer not in ("sgd", "adamw"):
+        raise ValueError(f"unknown optimizer {optimizer!r}")
+    if eval_every and eval_batches < 1:
+        raise ValueError(
+            f"eval_every={eval_every} needs eval_batches >= 1, got "
+            f"{eval_batches} (an empty eval set would log NaN losses)"
+        )
+    data_spec = (F._lm_token_spec(mesh) if cfg.vocab
+                 else F.flagship_data_spec(mesh))
+    opt_state = tx = None
+    if optimizer == "adamw":
+        import optax
+
+        tx = optax.adamw(lr, weight_decay=weight_decay)
+        # Template (structure + shardings) for a fresh start AND for
+        # restoring a saved state into.
+        opt_state = F.init_optimizer(tx, params)
+        if start_step and ckpt_dir:
+            if not os.path.exists(os.path.join(ckpt_dir, "opt_state.npz")):
+                raise ValueError(
+                    f"resuming adamw from {ckpt_dir}, but the checkpoint "
+                    "has no optimizer state (saved with sgd?)"
+                )
+            opt_state = C.load_opt_state(ckpt_dir, opt_state,
+                                         expect_step=start_step)
+        step_fn = F.make_flagship_optax_step(mesh, cfg, tx,
+                                             lm=bool(cfg.vocab),
+                                             donate=True)
+    elif cfg.vocab:
         step_fn = F.make_flagship_lm_train_step(mesh, cfg, lr=lr,
                                                 donate=True)
-        data_spec = F._lm_token_spec(mesh)
     else:
         step_fn = F.make_flagship_train_step(mesh, cfg, lr=lr, donate=True)
-        data_spec = F.flagship_data_spec(mesh)
+
+    eval_fn = None
+    if eval_every:
+        eval_fn = _make_eval_fn(mesh, cfg)
+        eval_set = []
+        src = _per_step_batches(cfg, seed + 999_983, 0)
+        sh = NamedSharding(mesh, data_spec)
+        for _ in range(eval_batches):
+            xb, tb = next(src)
+            eval_set.append((jax.device_put(jax.numpy.asarray(xb), sh),
+                             jax.device_put(jax.numpy.asarray(tb), sh)))
 
     loader = DeviceLoader(_per_step_batches(cfg, seed, start_step), mesh,
                           data_spec, prefetch=2)
@@ -140,7 +214,10 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
     saved_at = start_step - 1
     for step in range(start_step, steps):
         x, t = next(loader)
-        params, loss = step_fn(params, x, t)
+        if opt_state is not None:
+            params, opt_state, loss = step_fn(params, opt_state, x, t)
+        else:
+            params, loss = step_fn(params, x, t)
         if log_every and ((step + 1) % log_every == 0 or step + 1 == steps):
             dt = time.monotonic() - t0
             emit({
@@ -151,13 +228,21 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
                     (step + 1 - start_step) * tokens_per_step / dt
                 ),
             })
+        if eval_every and eval_fn and (step + 1) % eval_every == 0:
+            ev = float(np.mean([float(eval_fn(params, xe, te))
+                                for xe, te in eval_set]))
+            emit({"step": step + 1, "eval_loss": round(ev, 6)})
         if ckpt_every and ckpt_dir and (step + 1) % ckpt_every == 0:
             C.save_params(ckpt_dir, params, step=step + 1)
+            if opt_state is not None:
+                C.save_opt_state(ckpt_dir, opt_state, step=step + 1)
             saved_at = step + 1
     ran = max(0, steps - start_step)
     if ran and ckpt_dir and saved_at != steps:  # rolling save may have
         # already written this exact state — don't gather it twice
         C.save_params(ckpt_dir, params, step=steps)
+        if opt_state is not None:
+            C.save_opt_state(ckpt_dir, opt_state, step=steps)
     final = round(float(loss), 6) if loss is not None else None
     return {
         "start_step": start_step,
@@ -182,6 +267,10 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt-every", type=int, default=0, metavar="N")
     p.add_argument("--resume", action="store_true",
                    help="continue from the checkpoint in --ckpt-dir")
+    p.add_argument("--optimizer", default="sgd", choices=("sgd", "adamw"))
+    p.add_argument("--weight-decay", type=float, default=0.0)
+    p.add_argument("--eval-every", type=int, default=0, metavar="N")
+    p.add_argument("--eval-batches", type=int, default=2, metavar="K")
     p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
                    help="testing: force CPU platform with N simulated devices")
     # Model shape (FlagshipConfig fields).
@@ -234,6 +323,8 @@ def main(argv=None) -> int:
         log_every=args.log_every, ckpt_dir=args.ckpt_dir,
         ckpt_every=args.ckpt_every, resume=args.resume,
         log_path=args.log_jsonl, log_stream=sys.stdout,
+        optimizer=args.optimizer, weight_decay=args.weight_decay,
+        eval_every=args.eval_every, eval_batches=args.eval_batches,
     )
     summary.pop("params")
     print(json.dumps({"summary": summary}))

@@ -83,6 +83,76 @@ def load_params(path: str, mesh: Optional[Mesh] = None,
     return params, meta.get("step", 0)
 
 
+_OPT_META = "tpu_p2p_opt_state.json"
+
+
+def save_opt_state(path: str, opt_state, step: int = 0) -> str:
+    """Write an optimizer-state pytree (any structure) under ``path``.
+
+    Leaves are host-gathered and stored positionally (flatten order);
+    :func:`load_opt_state` restores them into a freshly-initialized
+    *template* state, which supplies structure and shardings — the
+    same contract as params resume (same config ⇒ same tree).
+    """
+    os.makedirs(path, exist_ok=True)
+    leaves = jax.tree.leaves(opt_state)
+    arrays = {f"l{i}": np.asarray(v) for i, v in enumerate(leaves)}
+    np.savez(os.path.join(path, "opt_state.npz"), **arrays)
+    with open(os.path.join(path, _OPT_META), "w") as fh:
+        json.dump(
+            {"step": step, "count": len(leaves),
+             "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
+             "shapes": {k: list(v.shape) for k, v in arrays.items()}},
+            fh,
+        )
+    return path
+
+
+def load_opt_state(path: str, template, expect_step: Optional[int] = None):
+    """Restore an optimizer state saved by :func:`save_opt_state` into
+    ``template``'s structure and placements (``template`` = the state
+    ``init_optimizer`` builds for the *same* optimizer and params).
+
+    ``expect_step``: the params checkpoint's step — params and
+    optimizer state are separate files, so a crash between the two
+    saves (or a dir reused across optimizers) can leave a stale
+    pairing; the recorded step makes that detectable."""
+    import ml_dtypes  # noqa: F401 — registers bfloat16 etc. with numpy
+
+    with open(os.path.join(path, _OPT_META)) as fh:
+        meta = json.load(fh)
+    if expect_step is not None and meta.get("step") != expect_step:
+        raise ValueError(
+            f"optimizer state at {path} was saved at step "
+            f"{meta.get('step')}, but the params checkpoint is at step "
+            f"{expect_step} — stale/torn optimizer state"
+        )
+    with np.load(os.path.join(path, "opt_state.npz")) as z:
+        arrays = {k: z[k] for k in z.files}
+    for k, want in meta.get("dtypes", {}).items():
+        if k in arrays and str(arrays[k].dtype) != want:
+            arrays[k] = arrays[k].view(np.dtype(want))
+    t_leaves, treedef = jax.tree.flatten(template)
+    if len(t_leaves) != meta["count"] or len(arrays) != meta["count"]:
+        raise ValueError(
+            f"optimizer state at {path} has {meta['count']} leaves; "
+            f"this optimizer/config expects {len(t_leaves)} — "
+            "optimizer/checkpoint mismatch"
+        )
+    out = []
+    for i, t in enumerate(t_leaves):
+        a = arrays[f"l{i}"]
+        if tuple(a.shape) != tuple(np.shape(t)):
+            raise ValueError(
+                f"optimizer leaf {i}: saved shape {a.shape} vs expected "
+                f"{np.shape(t)} — optimizer/checkpoint mismatch"
+            )
+        sharding = getattr(t, "sharding", None)
+        out.append(jax.device_put(a, sharding) if sharding is not None
+                   else jax.numpy.asarray(a))
+    return jax.tree.unflatten(treedef, out)
+
+
 def save_params_orbax(path: str, params: Params, step: int = 0) -> str:
     """Orbax save — multi-host safe, async-capable. Falls back to
     :func:`save_params` when orbax is unavailable."""
