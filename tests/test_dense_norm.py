@@ -127,6 +127,32 @@ def test_norm_dense_decode_matches_training_forward():
                                    err_msg=f"position {t}")
 
 
+def test_mixed_precision_decode_matches_forward():
+    # The decode stack's compute-dtype cast must mirror the training
+    # block's, or teacher-forced decode drifts from the forward.
+    cfg = F.FlagshipConfig(batch=4, seq=16, heads=4, head_dim=8, stages=2,
+                           microbatches=1, num_experts=2,
+                           capacity_factor=4.0, norm=True, rope=True,
+                           dtype="bfloat16", param_dtype="float32")
+    mesh = _mesh1()
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    assert all(np.asarray(v).dtype == np.dtype("float32")
+               for v in params.values())
+    x, _ = F.flagship_example_batch(cfg, mesh)
+    want = np.asarray(
+        F.make_flagship_forward(mesh, cfg)(params, x).astype(jnp.float32)
+    )
+    step = D.make_flagship_decode_step(mesh, cfg)
+    cache = D.init_kv_cache(cfg, max_len=cfg.seq, mesh=mesh)
+    assert cache["k"].dtype == jnp.bfloat16  # cache in compute dtype
+    for t in range(cfg.seq):
+        cache, y_t = step(params, cache, x[:, t:t + 1, :], t)
+        np.testing.assert_allclose(
+            np.asarray(y_t.astype(jnp.float32))[:, 0, :], want[:, t, :],
+            atol=3e-2, rtol=3e-2, err_msg=f"position {t}"  # bf16 math
+        )
+
+
 def test_lm_final_norm_decode_matches_forward():
     cfg = _cfg(batch=4, seq=16, microbatches=1, vocab=64)
     mesh = _mesh1()

@@ -173,11 +173,15 @@ def _decode_stack(params, cache: Cache, x, pos, cfg, tp, ep):
     from tpu_p2p.models.flagship import _rms_norm
 
     k_all, v_all = cache["k"], cache["v"]
+    compute = jnp.dtype(cfg.dtype)
     for s in range(cfg.stages):
         # Stage-major leaves only: 'emb' (vocab-leading) and 'lnf'
-        # (stage-less) have no stage dim to slice.
-        sub = {kk: vv[s] for kk, vv in params.items()
-               if kk not in ("emb", "lnf")}
+        # (stage-less) have no stage dim to slice. Mixed precision:
+        # cast storage-dtype params to the compute dtype, mirroring
+        # flagship._stage_block.
+        sub = {kk: (vv[s].astype(compute) if vv.dtype != compute
+                    else vv[s])
+               for kk, vv in params.items() if kk not in ("emb", "lnf")}
         # Project and write this token's K/V at pos (time axis 2) —
         # from the pre-normed activations, mirroring the train block.
         h = _rms_norm(x, sub["ln1"]) if cfg.norm else x

@@ -69,7 +69,14 @@ class FlagshipConfig:
     capacity_factor: float = 2.0
     moe_mult: int = 2        # expert FFN width = moe_mult * model_dim
     causal: bool = True
-    dtype: str = "float32"
+    dtype: str = "float32"   # compute dtype: activations and the
+    # in-block cast of params (bf16 puts the matmuls on the MXU's
+    # native path)
+    param_dtype: str = ""    # storage dtype for params ("" = same as
+    # dtype). param_dtype="float32" + dtype="bfloat16" is the classic
+    # mixed-precision recipe: f32 master weights (updates in f32 —
+    # _sgd_update/optax already do f32 math against the storage dtype),
+    # bf16 compute via a cast at block entry.
     sp_strategy: str = "ring"  # "ring" (ppermute KV rotation),
     # "ring_zigzag" (same transport, load-balanced causal layout — the
     # model then treats its sequence axis as zigzag-ordered, see
@@ -135,6 +142,10 @@ class FlagshipConfig:
     @property
     def model_dim(self) -> int:
         return self.heads * self.head_dim
+
+    @property
+    def params_dtype(self) -> str:
+        return self.param_dtype or self.dtype
 
     @property
     def num_kv_heads(self) -> int:
@@ -230,7 +241,7 @@ _GAIN_PARAMS = ("ln1", "ln2", "lnf")  # RMSNorm gains: init to ones
 
 def init_flagship_params(cfg: FlagshipConfig, seed: int = 0) -> Params:
     rng = np.random.default_rng(seed)
-    dtype = jnp.dtype(cfg.dtype)
+    dtype = jnp.dtype(cfg.params_dtype)
     return {
         name: (
             jnp.ones(shape, dtype)
@@ -389,12 +400,25 @@ def _dense_ffn(sub_params: Params, h, tp):
 def _stage_block(stage_params: Params, x, cfg: FlagshipConfig,
                  s_local: int, sp, tp, ep):
     """Apply this pp rank's ``s_local`` consecutive sub-blocks."""
-    body = _stage_sub_block
+    compute = jnp.dtype(cfg.dtype)
+
+    def cast_and_run(sub, x, cfg, sp, tp, ep):
+        # Mixed precision: params stored in params_dtype are cast to
+        # the compute dtype at block entry (autodiff transposes the
+        # cast, so grads flow back to the storage-dtype masters).
+        # Inside the remat boundary on purpose: checkpointed-call
+        # inputs stay live until the stage's backward, so casting
+        # outside would pin a compute-dtype copy of every stage's
+        # params — recomputing the cast from the masters is free.
+        sub = {k: v.astype(compute) if v.dtype != compute else v
+               for k, v in sub.items()}
+        return _stage_sub_block(sub, x, cfg, sp, tp, ep)
+
+    body = cast_and_run
     if cfg.remat:
         # Per-block rematerialization: save only each block's input,
         # recompute the block inside the backward.
-        body = jax.checkpoint(_stage_sub_block,
-                              static_argnums=(2, 3, 4, 5))
+        body = jax.checkpoint(cast_and_run, static_argnums=(2, 3, 4, 5))
     for i in range(s_local):
         sub = {k: v[i] for k, v in stage_params.items()}
         x = body(sub, x, cfg, sp, tp, ep)

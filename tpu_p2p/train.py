@@ -93,7 +93,8 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
                  resume: bool = False, log_path: Optional[str] = None,
                  log_stream=None, optimizer: str = "sgd",
                  weight_decay: float = 0.0, eval_every: int = 0,
-                 eval_batches: int = 2) -> dict:
+                 eval_batches: int = 2, clip_norm: float = 0.0,
+                 warmup_steps: int = 0, schedule: str = "constant") -> dict:
     """Train the flagship for ``steps`` global steps; returns a summary
     dict (``final_loss``, ``steps_run``, ``start_step``, ...).
 
@@ -101,6 +102,11 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
     its recorded step (no-op if already past ``steps``).
     ``optimizer="adamw"`` trains with optax AdamW; its moments are
     checkpointed alongside the params and restored on resume.
+    ``clip_norm``/``warmup_steps``/``schedule="cosine"`` add global-norm
+    gradient clipping and a warmup(+cosine-decay) learning-rate
+    schedule — any of them routes sgd through optax too (the schedule
+    count lives in the checkpointed optimizer state, so resume stays
+    bit-exact).
     ``eval_every=N`` evaluates the loss on a fixed held-out batch set
     (a disjoint seed stream) every N steps, emitting ``eval_loss``
     records to the same log.
@@ -122,7 +128,7 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
         # confusingly on a config/checkpoint mismatch).
         host, start_step = C.load_params(ckpt_dir)
         want_shapes = F.flagship_param_shapes(cfg)
-        want_dtype = np.dtype(cfg.dtype)
+        want_dtype = np.dtype(cfg.params_dtype)
         problems = []
         if set(host) != set(specs):
             problems.append(
@@ -154,6 +160,13 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
 
     if optimizer not in ("sgd", "adamw"):
         raise ValueError(f"unknown optimizer {optimizer!r}")
+    if schedule not in ("constant", "cosine"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if schedule == "cosine" and warmup_steps >= steps:
+        raise ValueError(
+            f"schedule='cosine' needs warmup_steps ({warmup_steps}) < "
+            f"steps ({steps}) — the decay phase would be empty"
+        )
     if eval_every and eval_batches < 1:
         raise ValueError(
             f"eval_every={eval_every} needs eval_batches >= 1, got "
@@ -162,29 +175,63 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
     data_spec = (F._lm_token_spec(mesh) if cfg.vocab
                  else F.flagship_data_spec(mesh))
     opt_state = tx = None
-    if optimizer == "adamw":
+    # Training-hygiene flags route even sgd through optax (the custom
+    # sgd step has nowhere to hang clipping or a schedule).
+    use_optax = (optimizer == "adamw" or clip_norm > 0
+                 or warmup_steps > 0 or schedule != "constant")
+    if use_optax:
         import optax
 
-        tx = optax.adamw(lr, weight_decay=weight_decay)
+        if schedule == "cosine":
+            sched = optax.warmup_cosine_decay_schedule(
+                0.0, lr, warmup_steps, decay_steps=max(steps, 1)
+            )
+        elif warmup_steps:
+            sched = optax.schedules.join_schedules(
+                [optax.schedules.linear_schedule(0.0, lr, warmup_steps),
+                 optax.schedules.constant_schedule(lr)],
+                [warmup_steps],
+            )
+        else:
+            sched = lr
+        base = (optax.adamw(sched, weight_decay=weight_decay)
+                if optimizer == "adamw" else optax.sgd(sched))
+        tx = (optax.chain(optax.clip_by_global_norm(clip_norm), base)
+              if clip_norm > 0 else base)
         # Template (structure + shardings) for a fresh start AND for
         # restoring a saved state into.
         opt_state = F.init_optimizer(tx, params)
         if start_step and ckpt_dir:
             if not os.path.exists(os.path.join(ckpt_dir, "opt_state.npz")):
                 raise ValueError(
-                    f"resuming adamw from {ckpt_dir}, but the checkpoint "
-                    "has no optimizer state (saved with sgd?)"
+                    f"resuming an optax run from {ckpt_dir}, but the "
+                    "checkpoint has no optimizer state (saved by the "
+                    "plain-sgd path?)"
                 )
             opt_state = C.load_opt_state(ckpt_dir, opt_state,
                                          expect_step=start_step)
         step_fn = F.make_flagship_optax_step(mesh, cfg, tx,
                                              lm=bool(cfg.vocab),
                                              donate=True)
-    elif cfg.vocab:
-        step_fn = F.make_flagship_lm_train_step(mesh, cfg, lr=lr,
-                                                donate=True)
     else:
-        step_fn = F.make_flagship_train_step(mesh, cfg, lr=lr, donate=True)
+        if start_step and ckpt_dir and os.path.exists(
+            os.path.join(ckpt_dir, "opt_state.npz")
+        ):
+            # The mirror of the missing-opt-state guard: resuming a
+            # hygiene/adamw checkpoint without those flags would
+            # silently drop the schedule count and moments mid-curve.
+            raise ValueError(
+                f"checkpoint at {ckpt_dir} carries optimizer state, but "
+                "this run uses the plain-sgd path — pass the original "
+                "--optimizer/--clip-norm/--warmup-steps/--schedule "
+                "flags (or remove opt_state.npz to discard it)"
+            )
+        if cfg.vocab:
+            step_fn = F.make_flagship_lm_train_step(mesh, cfg, lr=lr,
+                                                    donate=True)
+        else:
+            step_fn = F.make_flagship_train_step(mesh, cfg, lr=lr,
+                                                 donate=True)
 
     eval_fn = None
     if eval_every:
@@ -269,6 +316,11 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="continue from the checkpoint in --ckpt-dir")
     p.add_argument("--optimizer", default="sgd", choices=("sgd", "adamw"))
     p.add_argument("--weight-decay", type=float, default=0.0)
+    p.add_argument("--clip-norm", type=float, default=0.0,
+                   help="global-norm gradient clipping (0 = off)")
+    p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--schedule", default="constant",
+                   choices=("constant", "cosine"))
     p.add_argument("--eval-every", type=int, default=0, metavar="N")
     p.add_argument("--eval-batches", type=int, default=2, metavar="K")
     p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
@@ -285,6 +337,9 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--vocab", type=int, default=0)
     p.add_argument("--attn-window", type=int, default=0)
     p.add_argument("--dtype", default="float32")
+    p.add_argument("--param-dtype", default="",
+                   help="param storage dtype (e.g. float32 master "
+                        "weights with --dtype bfloat16 compute)")
     p.add_argument("--sp-strategy", default="ring",
                    choices=("ring", "ring_zigzag", "ulysses"))
     for flag in ("flash", "norm", "dense-ffn", "rope", "remat", "zero-dp"):
@@ -314,6 +369,7 @@ def main(argv=None) -> int:
         stages=args.stages, microbatches=args.microbatches,
         num_experts=args.experts, vocab=args.vocab,
         attn_window=args.attn_window, dtype=args.dtype,
+        param_dtype=args.param_dtype,
         sp_strategy=args.sp_strategy, use_flash=args.flash,
         norm=args.norm, dense_ffn=args.dense_ffn, rope=args.rope,
         remat=args.remat, zero_dp=args.zero_dp,
@@ -325,6 +381,8 @@ def main(argv=None) -> int:
         log_path=args.log_jsonl, log_stream=sys.stdout,
         optimizer=args.optimizer, weight_decay=args.weight_decay,
         eval_every=args.eval_every, eval_batches=args.eval_batches,
+        clip_norm=args.clip_norm, warmup_steps=args.warmup_steps,
+        schedule=args.schedule,
     )
     summary.pop("params")
     print(json.dumps({"summary": summary}))
