@@ -34,5 +34,10 @@ test:
 bench: native
 	$(PYTHON) bench.py
 
+# `make train ARGS="--steps 100 --ckpt-dir runs/a"` — the training
+# loop (tpu_p2p/train.py): loader + step + checkpoint/resume + JSONL.
+train:
+	$(PYTHON) -m tpu_p2p.train $(ARGS)
+
 clean:
 	rm -f $(NATIVE_SO)

@@ -255,6 +255,15 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
             with open(log_path, "a") as fh:
                 fh.write(line + "\n")
 
+    def save_ckpt(step_no):
+        C.save_params(ckpt_dir, params, step=step_no)
+        if opt_state is not None:
+            C.save_opt_state(ckpt_dir, opt_state, step=step_no)
+        else:
+            # Rolling overwrite: never leave a previous run's optimizer
+            # state paired with this run's params.
+            C.clear_opt_state(ckpt_dir)
+
     t0 = time.monotonic()
     tokens_per_step = cfg.batch * cfg.seq
     loss = None
@@ -280,16 +289,12 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
                                 for xe, te in eval_set]))
             emit({"step": step + 1, "eval_loss": round(ev, 6)})
         if ckpt_every and ckpt_dir and (step + 1) % ckpt_every == 0:
-            C.save_params(ckpt_dir, params, step=step + 1)
-            if opt_state is not None:
-                C.save_opt_state(ckpt_dir, opt_state, step=step + 1)
+            save_ckpt(step + 1)
             saved_at = step + 1
     ran = max(0, steps - start_step)
     if ran and ckpt_dir and saved_at != steps:  # rolling save may have
         # already written this exact state — don't gather it twice
-        C.save_params(ckpt_dir, params, step=steps)
-        if opt_state is not None:
-            C.save_opt_state(ckpt_dir, opt_state, step=steps)
+        save_ckpt(steps)
     final = round(float(loss), 6) if loss is not None else None
     return {
         "start_step": start_step,

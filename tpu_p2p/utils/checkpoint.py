@@ -108,6 +108,16 @@ def save_opt_state(path: str, opt_state, step: int = 0) -> str:
     return path
 
 
+def clear_opt_state(path: str) -> None:
+    """Remove any optimizer-state files under ``path`` — the plain-sgd
+    save path calls this so overwriting a rolling checkpoint dir never
+    leaves a stale ``opt_state.npz`` paired with newer params."""
+    for name in ("opt_state.npz", _OPT_META):
+        fp = os.path.join(path, name)
+        if os.path.exists(fp):
+            os.remove(fp)
+
+
 def load_opt_state(path: str, template, expect_step: Optional[int] = None):
     """Restore an optimizer state saved by :func:`save_opt_state` into
     ``template``'s structure and placements (``template`` = the state
