@@ -156,8 +156,32 @@ def test_ring_attention_workload(rt, capsys):
     mc = ModelConfig(batch=2, seq=64, heads=2, head_dim=8, dtype="float32")
     res = run_ring_attention(ctx, mc)
     assert res["devices"] == 8 and res["p50_ms"] > 0
+    assert res["hops"] == 7  # un-windowed: full rotation
     out = capsys.readouterr().out
     assert "ring_attention" in out and "TFLOP/s" in out
+
+
+def test_ring_attention_workload_windowed_drops_hops(rt, capsys):
+    from tpu_p2p.models.ring_transformer import ModelConfig
+    from tpu_p2p.workloads.ring_attn import run_ring_attention
+
+    # T=64 over 8 devices → T_local=8; window 8 needs only 1 hop.
+    ctx = _ctx(rt, iters=2, attn_window=8)
+    mc = ModelConfig(batch=2, seq=64, heads=2, head_dim=8, dtype="float32")
+    res = run_ring_attention(ctx, mc)
+    assert res["hops"] == 1
+    assert "x 1 hops" in capsys.readouterr().out
+
+
+def test_ulysses_attention_workload_windowed(rt, capsys):
+    from tpu_p2p.models.ring_transformer import ModelConfig
+    from tpu_p2p.workloads.ulysses_attn import run_ulysses_attention
+
+    ctx = _ctx(rt, iters=2, attn_window=8)
+    mc = ModelConfig(batch=2, seq=64, heads=8, head_dim=8, dtype="float32")
+    res = run_ulysses_attention(ctx, mc)
+    assert res["p50_ms"] > 0
+    assert "ulysses_attention" in capsys.readouterr().out
 
 
 def test_differential_mode_pairwise(rt, capsys):

@@ -83,6 +83,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--flash", action="store_true",
                    help="ring_attention: use the Pallas flash kernel for the "
                         "block-accumulate step")
+    p.add_argument("--attn-window", type=int, default=0, metavar="W",
+                   help="ring/ulysses_attention: sliding-window attention; "
+                        "windowed contiguous rings drop provably-dead hops")
     p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
                    help="testing: force CPU platform with N simulated devices")
     p.add_argument("--list-devices", action="store_true",
@@ -118,6 +121,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         resume=args.resume,
         profile_dir=args.profile_dir,
         use_flash=args.flash,
+        attn_window=args.attn_window,
     )
 
 

@@ -107,8 +107,12 @@ class BenchConfig:
     resume: bool = False  # skip cells already present in jsonl
     seed: int = 0
     profile_dir: Optional[str] = None  # jax.profiler trace output
-    use_flash: bool = False  # Pallas flash kernel on the ring_attention
-    # forward path (no VJP — benchmark/inference only)
+    use_flash: bool = False  # Pallas flash kernel on the SP attention
+    # workloads (trainable everywhere since tpu_p2p.ops.ring_flash)
+    attn_window: int = 0  # > 0: sliding-window attention on the SP
+    # workloads — windowed contiguous rings also DROP dead hops
+    # (tpu_p2p.ops.attention.live_ring_hops), which this surface makes
+    # measurable as shipped bytes
 
     def __post_init__(self) -> None:
         if self.pattern not in PATTERNS:
@@ -121,6 +125,16 @@ class BenchConfig:
             raise ValueError(f"direction {self.direction!r} not in {DIRECTIONS}")
         if self.iters <= 0:
             raise ValueError("iters must be positive")
+        if self.attn_window < 0:
+            raise ValueError(
+                f"attn_window must be >= 0, got {self.attn_window}"
+            )
+
+    @property
+    def window(self):
+        """``attn_window`` in the ops-layer convention (0 → None) —
+        the single translation point for the SP workloads."""
+        return self.attn_window or None
 
     def sizes(self) -> Tuple[int, ...]:
         if self.sweep:

@@ -343,8 +343,16 @@ def attention_sharding(mesh: Mesh, axis: str) -> NamedSharding:
     return NamedSharding(mesh, P(None, None, axis, None))
 
 
-def flops_per_step(b: int, h: int, t: int, d: int, *, causal: bool = False) -> int:
-    """Attention FLOPs for one forward: 2·(QK) + 2·(PV) matmuls."""
+def flops_per_step(b: int, h: int, t: int, d: int, *, causal: bool = False,
+                   window: Optional[int] = None) -> int:
+    """Attention FLOPs for one forward: 2·(QK) + 2·(PV) matmuls.
+
+    Causal halves the score matrix; a sliding window further limits
+    query ``i`` to ``min(i+1, W)`` keys."""
+    if causal and window is not None:
+        w = min(window, t)
+        keys = t * w - w * (w - 1) // 2
+        return 4 * b * h * keys * d
     total = 4 * b * h * t * t * d
     return total // 2 if causal else total
 

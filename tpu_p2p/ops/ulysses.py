@@ -36,7 +36,7 @@ import functools
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tpu_p2p.ops.attention import dense_attention
+from tpu_p2p.ops.attention import _check_window, dense_attention
 
 
 def _heads_to_seq(x, axis_name: str):
@@ -68,6 +68,8 @@ def ulysses_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
     forward-mode kernel — so this is the trainable flash+SP
     composition (the flagship's ``use_flash`` rides it).
     """
+    _check_window(window, causal)  # same contract as the ring paths:
+    # a non-causal or sub-1 window must raise, not silently ignore
     n = jax.lax.axis_size(axis_name)
     h, h_kv = q.shape[1], k.shape[1]
     for name, count in (("query heads", h), ("KV heads", h_kv)):
@@ -92,7 +94,8 @@ def ulysses_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
 
 
 @functools.lru_cache(maxsize=None)
-def ulysses_attention(mesh: Mesh, axis: str, causal: bool = False):
+def ulysses_attention(mesh: Mesh, axis: str, causal: bool = False,
+                      use_flash: bool = False, window=None):
     """Jitted global Ulysses attention over ``mesh``.
 
     Takes global ``[B, H, T, D]`` arrays with ``T`` sharded along
@@ -103,7 +106,8 @@ def ulysses_attention(mesh: Mesh, axis: str, causal: bool = False):
     spec = P(None, None, axis, None)
 
     def f(q, k, v):
-        return ulysses_attention_local(q, k, v, axis, causal=causal)
+        return ulysses_attention_local(q, k, v, axis, causal=causal,
+                                       use_flash=use_flash, window=window)
 
     return jax.jit(
         jax.shard_map(f, mesh=mesh, in_specs=(spec, spec, spec),

@@ -23,22 +23,29 @@ from tpu_p2p.workloads.sp_common import bench_sp_attention
 @workload("ring_attention")
 def run_ring_attention(ctx: WorkloadContext, model_cfg: ModelConfig = None) -> dict:
     cfg = ctx.cfg
+    window = cfg.window
     mc, axis, n, s, tflops = bench_sp_attention(
         ctx, model_cfg, default_heads=lambda n: 8,
         build_fn=lambda mesh, ax, m: A.ring_attention(
-            mesh, ax, m.causal, use_flash=cfg.use_flash
+            mesh, ax, m.causal, use_flash=cfg.use_flash, window=window
         ),
     )
     hop_bytes = A.kv_bytes_per_hop(
         mc.batch, mc.heads, mc.seq // n, mc.head_dim, mc.dtype
     )
-    comm_gbps = timing.gbps(hop_bytes * (n - 1), s.mean_region)
+    # Windowed contiguous rings rotate only through the live hops
+    # (tpu_p2p.ops.attention.live_ring_hops) — the shipped bytes drop
+    # with the window, which is exactly what this surface measures.
+    hops = A.live_ring_hops(n, mc.seq // n, mc.causal, "contiguous",
+                            window)
+    comm_gbps = timing.gbps(hop_bytes * hops, s.mean_region)
     if ctx.is_printer:
+        wtxt = f"W{window} " if window else ""
         sys.stdout.write(
             f"ring_attention B{mc.batch} H{mc.heads} T{mc.seq} D{mc.head_dim} "
-            f"{'causal ' if mc.causal else ''}over {n} devices: "
+            f"{'causal ' if mc.causal else ''}{wtxt}over {n} devices: "
             f"p50 {s.p50 * 1e3:.2f}ms/step  {tflops:.3f} TFLOP/s  "
-            f"{hop_bytes} KV bytes/hop x {n - 1} hops "
+            f"{hop_bytes} KV bytes/hop x {hops} hops "
             f"({comm_gbps:.2f} Gbps overlapped)\n"
         )
         sys.stdout.flush()
@@ -47,11 +54,12 @@ def run_ring_attention(ctx: WorkloadContext, model_cfg: ModelConfig = None) -> d
             ctx, workload="ring_attention", direction="uni", src=0, dst=1 % n,
             msg_bytes=hop_bytes, gbps_val=comm_gbps, samples=s,
             seq=mc.seq, batch=mc.batch, heads=mc.heads, head_dim=mc.head_dim,
-            tflops=tflops, causal=mc.causal,
+            tflops=tflops, causal=mc.causal, ring_hops=hops,
+            attn_window=window,
         )
     )
     return {
         "devices": n, "seq": mc.seq, "p50_ms": s.p50 * 1e3,
-        "tflops": tflops, "kv_bytes_per_hop": hop_bytes,
+        "tflops": tflops, "kv_bytes_per_hop": hop_bytes, "hops": hops,
         "comm_gbps_overlapped": comm_gbps,
     }

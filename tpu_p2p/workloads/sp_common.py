@@ -56,7 +56,8 @@ def bench_sp_attention(
         warmup=max(1, cfg.warmup), timeout_s=cfg.timeout_s, barrier=rt.barrier,
     )
     flops = A.flops_per_step(
-        mc.batch, mc.heads, mc.seq, mc.head_dim, causal=mc.causal
+        mc.batch, mc.heads, mc.seq, mc.head_dim, causal=mc.causal,
+        window=cfg.window if mc.causal else None,
     )
     step_s = s.p50
     tflops = flops / step_s / 1e12 if step_s == step_s else float("nan")

@@ -31,7 +31,10 @@ def run_ulysses_attention(ctx: WorkloadContext, model_cfg: ModelConfig = None) -
         )
     mc, axis, n, s, tflops = bench_sp_attention(
         ctx, model_cfg, default_heads=heads_multiple_of,
-        build_fn=lambda mesh, ax, m: U.ulysses_attention(mesh, ax, m.causal),
+        build_fn=lambda mesh, ax, m: U.ulysses_attention(
+            mesh, ax, m.causal, use_flash=ctx.cfg.use_flash,
+            window=ctx.cfg.window,
+        ),
     )
     reshard_bytes = U.a2a_bytes_per_reshard(
         mc.batch, mc.heads, mc.seq, mc.head_dim, n, mc.dtype
