@@ -29,6 +29,36 @@ def test_dryrun_multichip(n, capsys):
     assert "dryrun_multichip OK" in capsys.readouterr().out
 
 
+def test_dryrun_bootstraps_when_devices_missing(monkeypatch, capfd):
+    # The round-1 driver failure mode: the module is imported on a
+    # 1-chip backend and dryrun_multichip(8) is called directly.  The
+    # function must own its environment — re-exec on a simulated
+    # 8-device CPU platform — rather than assume the caller set one up.
+    # Simulated here by patching the visible-device count; the
+    # subprocess underneath gets real (forced-CPU) devices.
+    mod = _load()
+    monkeypatch.setattr(jax, "device_count", lambda: 1)
+    mod.dryrun_multichip(8)
+    # Subprocess output arrives at the fd level, hence capfd.
+    assert "dryrun_multichip OK" in capfd.readouterr().out
+
+
+def test_dryrun_bootstrap_surfaces_subprocess_failure(monkeypatch):
+    # A crashing dryrun subprocess must fail loudly (rc!=0 ->
+    # RuntimeError), not report ok — the driver records the exception.
+    import subprocess
+
+    mod = _load()
+    monkeypatch.setattr(jax, "device_count", lambda: 1)
+    monkeypatch.setattr(
+        subprocess,
+        "run",
+        lambda *a, **k: subprocess.CompletedProcess(a, returncode=1),
+    )
+    with pytest.raises(RuntimeError, match="dryrun_multichip subprocess"):
+        mod.dryrun_multichip(8)
+
+
 def test_dryrun_mesh_carries_all_five_axes():
     # The driver contract asks for real dp/pp/sp/tp/ep shardings: the
     # dryrun mesh must carry all five named axes (size-1 axes still
