@@ -16,17 +16,28 @@ differential chain timing — two chain lengths, slope = per-op time —
 which cancels every constant per-call cost including the relay round
 trip. See tpu_p2p/utils/timing.py.
 
-vs_baseline: ratio against the north-star anchor of BASELINE.md — the
-NCCL A100 NVLink3 p2p class (~200 GB/s = 1600 Gbps); the stated target
-is >= 0.8 of that on real multi-chip ICI (BASELINE.json "within 20%").
+vs_baseline: each branch compares against the anchor that measures the
+same physical thing, and names it in ``detail.baseline_anchor``:
+
+- multi-chip p2p bandwidth → the NCCL A100 NVLink3 p2p class
+  (~200 GB/s = 1600 Gbps); BASELINE.json's "within 20%" target.
+- single-chip loopback HBM rewrite → fraction of the chip's own HBM
+  peak (v5e ≈ 819 GB/s). An HBM-rewrite/NVLink ratio would be
+  apples-to-oranges (round-1 verdict weak #2); fraction-of-peak is the
+  honest scoreboard for a number that never crosses a link.
+
+Each branch's ``metric`` name is fixed (it names the measurement, not
+the round), so values are comparable across rounds on like hardware.
 """
 
 from __future__ import annotations
 
 import json
+import statistics
 import sys
 
 NVLINK_A100_GBPS = 1600.0  # ~200 GB/s busbw class, BASELINE.md anchor
+V5E_HBM_GBYTES_PER_S = 819.0  # v5e HBM peak, BASELINE.md sanity anchor
 
 
 def _flash_tflops(timing):
@@ -185,6 +196,73 @@ def _decode_metrics(timing):
     }
 
 
+def _select_pairs(all_pairs, max_pairs):
+    """Strided subsample of the ordered pair list, not a row-major
+    prefix: the prefix would be almost entirely src=0 edges, biasing
+    the "all-pairs" average toward one device's egress links on big or
+    multi-host meshes. Ceil stride: floor would degenerate to the
+    row-major prefix for N in [max, 2max)."""
+    stride = -(-len(all_pairs) // max_pairs)
+    return all_pairs[::stride][:max_pairs]
+
+
+def _latency_8b(timing, chain_of, payload):
+    """p50 device-side per-op latency on an 8-byte buffer.
+
+    BASELINE.json names "p50 send/recv latency @ 8 B" as a headline
+    metric. Differential slope between two chain lengths is the only
+    dispatch-free estimate here, but at sub-µs per op the slope can sit
+    below the repeat-to-repeat noise; round 1 clamped that case to 0.0
+    and published it, which is a non-measurement (verdict weak #3).
+    Instead: escalate the chain length until the median slope clears
+    the repeat spread; if it never does, publish an upper bound plus
+    the spread and an explicit null for the point estimate.
+
+    ``chain_of(k)`` must return a jitted function running ``k`` chained
+    ops on ``payload`` (loopback rewrites on one chip; a ppermute chain
+    on a real pair).
+    """
+    last = None
+    for iters in (4096, 16384, 65536):
+        s = timing.measure_differential(chain_of, payload, iters, repeats=6)
+        if s.timed_out or not s.iter_seconds:
+            break
+        slopes = sorted(s.iter_seconds)
+        med = statistics.median(slopes)
+        q1 = slopes[len(slopes) // 4]
+        q3 = slopes[(3 * len(slopes)) // 4]
+        iqr = q3 - q1
+        last = (med, slopes, iqr, iters)
+        if med > 0 and med > 2 * iqr:
+            return {
+                "latency_8b_p50_us": round(med * 1e6, 4),
+                "latency_8b_spread_us": [
+                    round(slopes[0] * 1e6, 4), round(slopes[-1] * 1e6, 4)
+                ],
+                "latency_8b_chain_iters": iters,
+            }
+    if last is None:
+        return {"latency_8b_p50_us": None}
+    med, slopes, iqr, iters = last
+    # Below noise floor even at the longest chain: publish a bound,
+    # not a point estimate. The max across repeats overestimates the
+    # true slope with high probability under roughly symmetric noise —
+    # a defensible "< X µs" where round 1 printed a fake 0.0. With no
+    # positive slope at all, even a bound would be a claim of "< 0 µs":
+    # publish only the spread (the measurement failed, say so).
+    pos = [sl for sl in slopes if sl > 0]
+    out = {
+        "latency_8b_p50_us": None,
+        "latency_8b_spread_us": [
+            round(slopes[0] * 1e6, 4), round(slopes[-1] * 1e6, 4)
+        ],
+        "latency_8b_chain_iters": iters,
+    }
+    if pos:
+        out["latency_8b_us_upper_bound"] = round(max(pos) * 1e6, 4)
+    return out
+
+
 def main() -> int:
     import numpy as np
 
@@ -217,12 +295,7 @@ def main() -> int:
             print("# ignoring unparseable BENCH_MAX_PAIRS", file=sys.stderr)
             max_pairs = 24
         all_p = [p for p in C.all_pairs(n) if p[0] != p[1]]
-        # Strided subsample, not a row-major prefix: the prefix would be
-        # almost entirely src=0 edges, biasing the "all-pairs" average
-        # toward one device's egress links on big or multi-host meshes.
-        stride = -(-len(all_p) // max_pairs)  # ceil: floor would
-        # degenerate to the row-major prefix for N in [max, 2max)
-        pairs = all_p[::stride][:max_pairs]
+        pairs = _select_pairs(all_p, max_pairs)
         for i, (src, dst) in enumerate(pairs):
             # Differential unconditionally: the relay's block fence is
             # erratic (sometimes acks enqueue), and differential is
@@ -238,10 +311,28 @@ def main() -> int:
             print(f"# pair {i + 1}/{len(pairs)} ({src}->{dst}): "
                   f"{cells[-1]:.1f} Gbps", file=sys.stderr, flush=True)
         value = float(np.mean(cells))
+        # The headline 8 B p50 latency (BASELINE.json) on one
+        # representative inter-device edge. Guarded like the model
+        # metrics below: a latency failure must not discard the
+        # bandwidth sweep already measured above.
+        src, dst = pairs[0]
+        try:
+            lat = _latency_8b(
+                timing,
+                lambda k, e=C.unidir_edges(src, dst): cache.permute_chain(
+                    rt.mesh, "d", e, k
+                ),
+                C.make_payload(rt.mesh, 8),
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"# latency measurement failed: {e!r}", file=sys.stderr)
+            lat = {"latency_8b_p50_us": None}
         result = {
             "metric": "all_pairs_unidir_bandwidth_avg",
             "value": round(value, 3),
             "unit": "Gbps",
+            # Genuine p2p vs the NCCL A100 NVLink p2p class — the one
+            # comparison BASELINE.json's "within 20%" target defines.
             "vs_baseline": round(value / NVLINK_A100_GBPS, 4),
             "detail": {
                 "devices": n,
@@ -250,8 +341,14 @@ def main() -> int:
                 "max_gbps": round(float(np.max(cells)), 3),
                 "msg_bytes": msg,
                 "iters": iters,
+                "latency_pair": [src, dst],
+                **lat,
                 "mode": "differential",
                 "block_fence_trustworthy": fence_ok,
+                "baseline_anchor": {
+                    "name": "nccl_a100_nvlink3_p2p",
+                    "value_gbps": NVLINK_A100_GBPS,
+                },
             },
         }
     else:
@@ -264,11 +361,22 @@ def main() -> int:
             lambda k: cache.loopback_chain(rt.mesh, k), xb, iters, repeats=4
         )
         value = timing.gbps(big, s.mean_region)
-        # Device-side per-op latency floor on a tiny buffer. Long
-        # chains so the slope clears relay-round-trip noise.
-        x8 = C.make_payload(rt.mesh, 128)
-        s8 = timing.measure_differential(
-            lambda k: cache.loopback_chain(rt.mesh, k), x8, 4096, repeats=4
+        # Headline 8 B p50 latency analogue: per-op floor of an 8-byte
+        # loopback rewrite chain (no inter-chip edge exists here).
+        # Guarded: the bandwidth number above survives a latency crash.
+        try:
+            lat = _latency_8b(
+                timing,
+                lambda k: cache.loopback_chain(rt.mesh, k),
+                C.make_payload(rt.mesh, 8),
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"# latency measurement failed: {e!r}", file=sys.stderr)
+            lat = {"latency_8b_p50_us": None}
+        hbm_gbytes = (
+            round(2 * big / s.mean_region / 1e9, 1)
+            if s.mean_region > 0
+            else None
         )
         try:
             flash_tflops = _flash_tflops(timing)
@@ -294,22 +402,30 @@ def main() -> int:
             "metric": "loopback_hbm_rewrite_bandwidth",
             "value": round(float(value), 3),
             "unit": "Gbps",
-            "vs_baseline": round(float(value) / NVLINK_A100_GBPS, 4),
+            # Fraction of the chip's own HBM peak: each rewrite op
+            # moves 2*msg bytes (read + write) through HBM, and this
+            # traffic never crosses a chip-to-chip link, so the NVLink
+            # p2p anchor does not apply (round-1 verdict weak #2).
+            "vs_baseline": (
+                round(hbm_gbytes / V5E_HBM_GBYTES_PER_S, 4)
+                if hbm_gbytes is not None
+                else None
+            ),
             "detail": {
                 "devices": 1,
                 "device_kind": str(rt.devices[0].device_kind),
                 "msg_bytes": big,
-                "hbm_gbytes_per_s": (
-                    round(2 * big / s.mean_region / 1e9, 1)
-                    if s.mean_region > 0
-                    else None
-                ),
-                "per_op_floor_us": round(s8.mean_region * 1e6, 2),
+                "hbm_gbytes_per_s": hbm_gbytes,
+                **lat,
                 "flash_attention_tflops": flash_tflops,
                 **flagship,
                 **decode,
                 "mode": "differential",
                 "block_fence_trustworthy": fence_ok,
+                "baseline_anchor": {
+                    "name": "v5e_hbm_peak",
+                    "value_gbytes_per_s": V5E_HBM_GBYTES_PER_S,
+                },
             },
         }
     print(json.dumps(result))
