@@ -148,3 +148,43 @@ def test_orbax_loader_npz_fallback_rejects_wrong_step(tmp_path):
         raise AssertionError("expected step-mismatch error")
     except ValueError as e:
         assert "step" in str(e)
+
+
+def test_opt_state_structure_mismatch_rejected(tmp_path):
+    # Leaves are stored positionally; two same-shaped leaves in a
+    # different tree structure (e.g. mu/nu swapped by another optax
+    # version's node order) must be refused, not silently mis-paired.
+    import pytest
+
+    a = np.ones((2, 2), np.float32)
+    b = np.full((2, 2), 3.0, np.float32)
+    C.save_opt_state(str(tmp_path), {"mu": a, "nu": b}, step=1)
+    with pytest.raises(ValueError, match="mis-pair"):
+        C.load_opt_state(str(tmp_path), (a, b), expect_step=1)
+    # The matching structure still restores.
+    out = C.load_opt_state(
+        str(tmp_path),
+        {"mu": np.zeros((2, 2), np.float32),
+         "nu": np.zeros((2, 2), np.float32)},
+        expect_step=1,
+    )
+    np.testing.assert_array_equal(np.asarray(out["mu"]), a)
+    np.testing.assert_array_equal(np.asarray(out["nu"]), b)
+
+
+def test_opt_state_pre_treedef_checkpoint_still_loads(tmp_path):
+    # Checkpoints written before the leaf-path fingerprint existed lack
+    # the key; count+shape checks still apply, structure is trusted.
+    import json as _json
+    import os as _os
+
+    a = np.ones((2,), np.float32)
+    C.save_opt_state(str(tmp_path), (a,), step=0)
+    meta_path = _os.path.join(str(tmp_path), "tpu_p2p_opt_state.json")
+    with open(meta_path) as fh:
+        meta = _json.load(fh)
+    del meta["leaf_paths"]
+    with open(meta_path, "w") as fh:
+        _json.dump(meta, fh)
+    (out,) = C.load_opt_state(str(tmp_path), (np.zeros((2,), np.float32),))
+    np.testing.assert_array_equal(np.asarray(out), a)

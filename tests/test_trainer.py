@@ -235,3 +235,25 @@ def test_cli_entry(tmp_path):
     assert rc == 0
     lines = (tmp_path / "cli.jsonl").read_text().splitlines()
     assert len(lines) == 2
+
+
+def test_cosine_resume_horizon_change_rejected(tmp_path):
+    # decay_steps derives from --steps; resuming with a different
+    # --steps would silently reshape the LR curve mid-run. The
+    # schedule metadata persisted with the checkpoint pins it.
+    import pytest
+
+    mesh = F.build_mesh(8)
+    cfg = _cfg()
+    kw = dict(lr=2e-2, log_every=0, schedule="cosine", warmup_steps=1)
+    ck = str(tmp_path / "cos")
+    run_training(mesh, cfg, steps=4, ckpt_dir=ck, ckpt_every=2, **kw)
+    with pytest.raises(ValueError, match="decay_steps"):
+        run_training(mesh, cfg, steps=6, ckpt_dir=ck, resume=True, **kw)
+    # A drifted lr is caught by the same guard…
+    with pytest.raises(ValueError, match="lr"):
+        run_training(mesh, cfg, steps=4, ckpt_dir=ck, resume=True,
+                     **{**kw, "lr": 1e-3})
+    # …while unchanged flags resume cleanly (no-op: already at 4).
+    out = run_training(mesh, cfg, steps=4, ckpt_dir=ck, resume=True, **kw)
+    assert out["steps_run"] == 0 and out["start_step"] == 4

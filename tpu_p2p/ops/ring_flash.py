@@ -202,17 +202,20 @@ def _ring_flash_bwd(axis_name, causal, layout, window, res, g):
 
     def hop(carry, i):
         dq, k_cur, v_cur, dka, dva = carry
+        # Prefetch the next KV block WHILE computing this hop's grads —
+        # same overlap as the forward: only the (dka, dva) rotation has
+        # a true ordering dependency on _block_grads (the accumulator
+        # travels WITH its KV block; after a full rotation both are
+        # back at the owner), so only those permutes stay behind it.
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, edges)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, edges)
         src = jax.lax.rem(my - i + n + n, n)
         dq, dka, dva = _block_grads(dq, dka, dva, q, k_cur, v_cur, g, L,
                                     delta, my, src, n, causal, layout,
                                     window)
-        # The (dk, dv) accumulator travels WITH its KV block: after a
-        # full rotation both are back at the owner.
-        k_cur = jax.lax.ppermute(k_cur, axis_name, edges)
-        v_cur = jax.lax.ppermute(v_cur, axis_name, edges)
         dka = jax.lax.ppermute(dka, axis_name, edges)
         dva = jax.lax.ppermute(dva, axis_name, edges)
-        return (dq, k_cur, v_cur, dka, dva), None
+        return (dq, k_nxt, v_nxt, dka, dva), None
 
     hops = _live_hops(n, t, causal, layout, window)
     if hops > 0:

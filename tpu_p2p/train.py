@@ -41,6 +41,9 @@ from typing import Iterator, Optional
 import numpy as np
 
 
+_SCHED_META = "train_schedule.json"
+
+
 def _per_step_batches(cfg, seed: int, start_step: int) -> Iterator:
     """Host batches keyed by (seed, global step) — resumable exactly."""
     from tpu_p2p.models.flagship import flagship_host_batch
@@ -179,6 +182,17 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
     # sgd step has nowhere to hang clipping or a schedule).
     use_optax = (optimizer == "adamw" or clip_norm > 0
                  or warmup_steps > 0 or schedule != "constant")
+    # The LR-curve parameters that define the schedule the optimizer
+    # state's count indexes into. decay_steps is derived from --steps,
+    # so resuming with a different --steps would silently reshape the
+    # cosine mid-run even though the count itself resumes bit-exact —
+    # persisted with the checkpoint and compared at resume.
+    sched_meta = {
+        "optimizer": optimizer, "schedule": schedule, "lr": lr,
+        "warmup_steps": warmup_steps,
+        "decay_steps": max(steps, 1) if schedule == "cosine" else None,
+        "clip_norm": clip_norm, "weight_decay": weight_decay,
+    }
     if use_optax:
         import optax
 
@@ -208,6 +222,22 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
                     "checkpoint has no optimizer state (saved by the "
                     "plain-sgd path?)"
                 )
+            sched_path = os.path.join(ckpt_dir, _SCHED_META)
+            if os.path.exists(sched_path):  # absent in pre-r2 ckpts
+                with open(sched_path) as fh:
+                    saved = json.load(fh)
+                diffs = [
+                    f"{k}: checkpoint {saved.get(k)!r} vs this run {v!r}"
+                    for k, v in sched_meta.items() if saved.get(k) != v
+                ]
+                if diffs:
+                    raise ValueError(
+                        f"resume at {ckpt_dir} changes the optimizer/"
+                        "LR-schedule shape mid-run: "
+                        + "; ".join(diffs)
+                        + " — pass the original flags (a different "
+                        "--steps reshapes cosine decay_steps)"
+                    )
             opt_state = C.load_opt_state(ckpt_dir, opt_state,
                                          expect_step=start_step)
         step_fn = F.make_flagship_optax_step(mesh, cfg, tx,
@@ -259,10 +289,16 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
         C.save_params(ckpt_dir, params, step=step_no)
         if opt_state is not None:
             C.save_opt_state(ckpt_dir, opt_state, step=step_no)
+            with open(os.path.join(ckpt_dir, _SCHED_META), "w") as fh:
+                json.dump(sched_meta, fh)
         else:
             # Rolling overwrite: never leave a previous run's optimizer
-            # state paired with this run's params.
+            # state (or its schedule metadata) paired with this run's
+            # params.
             C.clear_opt_state(ckpt_dir)
+            sp = os.path.join(ckpt_dir, _SCHED_META)
+            if os.path.exists(sp):
+                os.remove(sp)
 
     t0 = time.monotonic()
     tokens_per_step = cfg.batch * cfg.seq

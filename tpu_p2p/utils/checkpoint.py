@@ -95,12 +95,21 @@ def save_opt_state(path: str, opt_state, step: int = 0) -> str:
     same contract as params resume (same config ⇒ same tree).
     """
     os.makedirs(path, exist_ok=True)
-    leaves = jax.tree.leaves(opt_state)
+    flat, _ = jax.tree_util.tree_flatten_with_path(opt_state)
+    leaves = [v for _, v in flat]
     arrays = {f"l{i}": np.asarray(v) for i, v in enumerate(leaves)}
     np.savez(os.path.join(path, "opt_state.npz"), **arrays)
     with open(os.path.join(path, _OPT_META), "w") as fh:
         json.dump(
             {"step": step, "count": len(leaves),
+             # Pairing fingerprint: leaves are stored positionally, so
+             # two same-shaped leaves swapped by a different optax
+             # version's tree order (mu vs nu) would otherwise restore
+             # silently mis-paired. Per-leaf key paths name exactly
+             # which slot each array came from (and unlike the full
+             # PyTreeDef repr they don't encode node internals whose
+             # rendering shifts across JAX versions).
+             "leaf_paths": [jax.tree_util.keystr(kp) for kp, _ in flat],
              "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
              "shapes": {k: list(v.shape) for k, v in arrays.items()}},
             fh,
@@ -142,13 +151,27 @@ def load_opt_state(path: str, template, expect_step: Optional[int] = None):
     for k, want in meta.get("dtypes", {}).items():
         if k in arrays and str(arrays[k].dtype) != want:
             arrays[k] = arrays[k].view(np.dtype(want))
-    t_leaves, treedef = jax.tree.flatten(template)
+    t_flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    t_leaves = [v for _, v in t_flat]
     if len(t_leaves) != meta["count"] or len(arrays) != meta["count"]:
         raise ValueError(
             f"optimizer state at {path} has {meta['count']} leaves; "
             f"this optimizer/config expects {len(t_leaves)} — "
             "optimizer/checkpoint mismatch"
         )
+    saved_paths = meta.get("leaf_paths")  # absent in pre-r2 checkpoints
+    if saved_paths is not None:
+        want_paths = [jax.tree_util.keystr(kp) for kp, _ in t_flat]
+        if saved_paths != want_paths:
+            moved = [f"slot {i}: saved {s!r} vs expected {w!r}"
+                     for i, (s, w) in enumerate(zip(saved_paths, want_paths))
+                     if s != w][:4]
+            raise ValueError(
+                f"optimizer state at {path} pairs its leaves differently "
+                f"than this optimizer/config ({'; '.join(moved)}) — "
+                "positional restore would silently mis-pair same-shaped "
+                "leaves (e.g. mu vs nu); refusing"
+            )
     out = []
     for i, t in enumerate(t_leaves):
         a = arrays[f"l{i}"]
