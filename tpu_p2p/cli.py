@@ -80,6 +80,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip cells already recorded in --jsonl")
     p.add_argument("--profile-dir", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run")
+    p.add_argument("--validate-timing", action="store_true",
+                   help="after the run, cross-check the host differential "
+                        "slope against XLA's device-trace timeline on a "
+                        "canonical chain (loopback on 1 device, ring "
+                        "ppermute otherwise); MISMATCH exits nonzero")
     p.add_argument("--flash", action="store_true",
                    help="ring_attention: use the Pallas flash kernel for the "
                         "block-accumulate step")
@@ -153,6 +158,44 @@ def _print_devices(rt) -> None:
         print(f"  torus dims: {rt.torus.dims}")
 
 
+def _validate_timing(rt, cfg) -> int:
+    """SURVEY.md §7(b): cross-check host differential timing against
+    XLA's device-event timeline (the ``cudaEvent_t`` analogue) on one
+    canonical chain for this mesh. Prints one diagnostic line; a
+    MISMATCH (device track present but slopes disagree beyond 2x)
+    exits 1 so CI can gate on it.
+    """
+    import tempfile
+
+    from tpu_p2p.parallel import collectives as C
+    from tpu_p2p.utils import timing
+    from tpu_p2p.utils.profiling import validate_differential
+
+    cache = C.CollectiveCache()
+    import numpy as np
+
+    msg = cfg.msg_size or 4 * 1024 * 1024
+    x = C.make_payload(rt.mesh, msg, dtype=np.dtype(cfg.dtype))
+    n = rt.num_devices
+    if n >= 2:
+        axis = rt.mesh.axis_names[0]
+        edges = C.ring_edges(n)
+        chain_of = lambda k: cache.permute_chain(rt.mesh, axis, edges, k)  # noqa: E731
+        label = f"ring ppermute x{n}"
+    else:
+        chain_of = lambda k: cache.loopback_chain(rt.mesh, k)  # noqa: E731
+        label = "loopback rewrite"
+    with tempfile.TemporaryDirectory(prefix="tpu_p2p_vt_") as td:
+        # 128-op chains: the long-short delta must clear relay jitter
+        # (measured ±5 ms per call some sessions) for the host slope
+        # to be meaningful at all; at 4 MiB+ payloads 112 extra ops is
+        # tens of ms of real device time.
+        v = validate_differential(chain_of, x, max(128, cfg.iters),
+                                  trace_dir=td, timing=timing, repeats=5)
+    print(f"# {v.describe()}  [{label}, {msg} B]")
+    return 0 if v.ok in (True, None) else 1
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     try:
@@ -209,6 +252,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         finally:
             if ctx.jsonl is not None:
                 ctx.jsonl.close()
+        if args.validate_timing:
+            return _validate_timing(rt, cfg)
         return 0
     except KeyboardInterrupt:
         print("interrupted", file=sys.stderr)

