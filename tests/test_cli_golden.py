@@ -1,0 +1,87 @@
+"""Golden-file pin of the CLI's end-to-end stdout contract.
+
+The matrix block's byte layout is the product's contract
+(p2p_matrix.cc:133-194: section titles, ``   D\\D`` header, ``%6d``
+row labels, ``%6.02f`` cells, ``0.00`` diagonal); round 1 asserted the
+formatter in unit tests but never pinned the ``__main__`` path end to
+end. This test runs ``python -m tpu_p2p`` as a real subprocess on the
+simulated 8-device CPU mesh and byte-diffs the output against a stored
+golden, with the measured Gbps digits masked (they are CPU memcpy
+speeds — plumbing, not numbers worth pinning).
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "cli_pairwise_8dev.txt")
+ARGS = ["--cpu-mesh", "8", "--iters", "2", "--msg-size", "256KiB"]
+
+_FIELD = re.compile(r" *\d+\.\d\d")  # a whole padded %6.02f field
+_FLOAT = re.compile(r"\d+\.\d\d")
+
+
+def mask(text: str) -> str:
+    """Replace measured values with fixed tokens, magnitude-invariant.
+
+    Matrix cells mask the *entire padded span* (separator + %6.02f
+    field — 7 chars for every value below 1000) to a right-justified
+    ``####`` token of the span's length, so a 1.23 and a 12.34 Gbps
+    cell mask identically: the diff pins layout, not CPU memcpy
+    magnitude. A cell over 999.99 Gbps widens its span and therefore
+    its token — that IS a (deliberate) layout diff. The diagonal keeps
+    its literal ``0.00`` (format contract, not measurement:
+    p2p_matrix.cc:147-151); summary-line floats collapse to a fixed
+    ``####``.
+    """
+    out = []
+    for line in text.splitlines(keepends=True):
+        m = re.match(r"\s+(\d+)\s", line)
+        if m and not line.lstrip().startswith("D\\D"):
+            row = int(m.group(1))
+            col = -1
+
+            def sub(mm, row=row):
+                nonlocal col
+                col += 1
+                field = mm.group(0)
+                if col == row and field.strip() == "0.00":
+                    return field
+                return "####".rjust(len(field))
+
+            line = _FIELD.sub(sub, line)
+        elif line.startswith("#"):
+            line = _FLOAT.sub("####", line)
+        out.append(line)
+    return "".join(out)
+
+
+def _run_cli() -> str:
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_p2p", *ARGS],
+        capture_output=True, text=True, cwd=REPO, timeout=540,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout
+
+
+def test_cli_matches_golden():
+    got = mask(_run_cli())
+    with open(GOLDEN) as fh:
+        want = fh.read()
+    assert got == want, (
+        "CLI stdout drifted from the golden contract.\n"
+        "If the change is intentional, regenerate with:\n"
+        f"  python -m tests.test_cli_golden\n--- got ---\n{got}"
+    )
+
+
+if __name__ == "__main__":
+    # Regenerate the golden from a live run.
+    os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+    with open(GOLDEN, "w") as fh:
+        fh.write(mask(_run_cli()))
+    print(f"wrote {GOLDEN}")

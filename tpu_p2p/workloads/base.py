@@ -66,7 +66,17 @@ class WorkloadContext:
         return jax.process_index() == 0
 
     def record(self, rec: CellRecord) -> None:
-        if self.jsonl is not None:
+        """Append a cell record — printer rank only.
+
+        Every process measures every cell (SPMD), so unguarded writes
+        under multi-host would append one duplicate record per process
+        (shared filesystem) or scatter partial logs (local ones).
+        Rank-0-only writes keep the JSONL a single authoritative log;
+        --resume under multi-host therefore requires the JSONL on a
+        filesystem all processes can read, so every rank skips the
+        same cells and stays aligned at the barriers.
+        """
+        if self.jsonl is not None and self.is_printer:
             self.jsonl.write(rec)
 
     def previously_done(self, key: tuple) -> Optional[float]:
