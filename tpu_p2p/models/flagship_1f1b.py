@@ -1,0 +1,194 @@
+"""Flagship under the manual (interleaved) 1F1B pipeline executor.
+
+Split from flagship.py (round 2); see :mod:`tpu_p2p.models.flagship`
+for the model overview and
+:mod:`tpu_p2p.models.pipeline_interleaved` for the schedule machinery.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_p2p.models.flagship_config import (
+    FlagshipConfig,
+    _data_axes,
+    _mesh_axes,
+)
+from tpu_p2p.models.flagship_forward import _stage_block
+from tpu_p2p.models.flagship_params import (
+    Params,
+    flagship_data_spec,
+    flagship_param_specs,
+)
+from tpu_p2p.models.flagship_steps import _sgd_update
+
+
+def place_flagship_params_pipelined(params: Params, mesh: Mesh,
+                                    cfg: FlagshipConfig,
+                                    chunks: int = 1) -> Params:
+    """Device-put stage-major params in the 1F1B device-major layout.
+
+    ``chunks`` MUST match the train step's — the layouts have identical
+    shapes, so a mismatch trains silently wrong. Prefer
+    :class:`FlagshipPipelined`, which carries ``chunks`` once.
+    """
+    from tpu_p2p.models.pipeline_interleaved import to_device_major
+
+    if cfg.vocab:
+        raise ValueError(
+            "vocab (the LM head) is unsupported with the 1F1B layout; "
+            "the emb leaf has no stage axis to permute"
+        )
+    n = mesh.shape["pp"]
+    s_chunk = cfg.stages // (n * chunks)
+    specs = flagship_param_specs(mesh, cfg)
+    return {k: jax.device_put(
+                jnp.asarray(to_device_major(np.asarray(v), n, chunks,
+                                            s_chunk)),
+                NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}
+
+
+def unplace_flagship_params_pipelined(params: Params, mesh: Mesh,
+                                      cfg: FlagshipConfig,
+                                      chunks: int = 1) -> Params:
+    """Back to stage-major order (for checkpointing / oracle checks)."""
+    from tpu_p2p.models.pipeline_interleaved import from_device_major
+
+    n = mesh.shape["pp"]
+    s_chunk = cfg.stages // (n * chunks)
+    return {k: from_device_major(np.asarray(v), n, chunks, s_chunk)
+            for k, v in params.items()}
+
+
+class FlagshipPipelined:
+    """The 1F1B flagship bundle: one object owns ``chunks``, so the
+    parameter layout and the schedule can never disagree (the two
+    layouts are shape-identical — a mismatch would train silently
+    wrong, which is why the loose functions warn and this exists).
+
+    >>> fp = FlagshipPipelined(mesh, cfg, chunks=2, lr=1e-3)
+    >>> params = fp.place(init_flagship_params(cfg))
+    >>> params, loss = fp.step(params, x, t)
+    >>> host = fp.unplace(params)   # stage-major, for checkpoints
+    """
+
+    def __init__(self, mesh: Mesh, cfg: FlagshipConfig, chunks: int = 1,
+                 lr: float = 1e-2):
+        self.mesh, self.cfg, self.chunks = mesh, cfg, chunks
+        self.step = make_flagship_train_step_1f1b(mesh, cfg, lr=lr,
+                                                  chunks=chunks)
+
+    def place(self, params: Params) -> Params:
+        return place_flagship_params_pipelined(params, self.mesh, self.cfg,
+                                               self.chunks)
+
+    def unplace(self, params: Params) -> Params:
+        return unplace_flagship_params_pipelined(params, self.mesh,
+                                                 self.cfg, self.chunks)
+
+
+def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
+                                  lr: float = 1e-2, chunks: int = 1):
+    """The flagship step under the manual (interleaved) 1F1B executor.
+
+    The capstone composition: pipeline ticks from
+    :mod:`tpu_p2p.models.pipeline_interleaved` (manual per-tick
+    ``jax.vjp`` with rematerialized forwards, O(S)-bounded activation
+    stash) whose stage block runs the full transformer sub-block —
+    ring/Ulysses sp attention, Megatron tp ``psum``, MoE ep
+    ``all_to_all`` — inside the vjp. Gradient accounting under manual
+    backprop: ``jax.vjp`` *inside* shard_map already inserts the
+    cross-shard psum for any axis the primal doesn't vary over (the
+    per-tick dchunk arrives fully summed over dp/ep/sp and tp-joined),
+    so only the loss needs an explicit data-axis psum — and each
+    gradient accumulator is typed by its param's own sharded axes.
+    Params use the device-major chunk layout
+    (:func:`place_flagship_params_pipelined`); ``chunks > 1`` gives the
+    interleaved virtual-stage schedule. ``zero_dp`` is unsupported here
+    (ZeRO's gather-on-use transpose needs autodiff owning the params).
+    """
+    from tpu_p2p.models.pipeline_1f1b import _mse_loss_grad
+    from tpu_p2p.models.pipeline_interleaved import (
+        build_interleaved_schedule,
+        interleaved_grads_local,
+    )
+
+    if cfg.zero_dp:
+        raise ValueError(
+            "zero_dp is unsupported with the manual 1F1B step; use the "
+            "GPipe train step (autodiff owns the ZeRO gather) or turn "
+            "zero_dp off"
+        )
+    if cfg.vocab:
+        raise ValueError(
+            "vocab (the LM head) is unsupported with the manual 1F1B "
+            "step; use make_flagship_lm_train_step (GPipe autodiff)"
+        )
+    axes = _mesh_axes(mesh)
+    if "pp" not in axes:
+        raise ValueError("mesh needs a 'pp' axis for pipeline parallelism")
+    n = mesh.shape["pp"]
+    if cfg.stages % (n * chunks):
+        raise ValueError(
+            f"stages ({cfg.stages}) must divide by pp size ({n}) x "
+            f"chunks ({chunks})"
+        )
+    s_chunk = cfg.stages // (n * chunks)
+    sched = build_interleaved_schedule(cfg.microbatches, n, chunks)
+    sp, tp, ep = axes.get("sp"), axes.get("tp"), axes.get("ep")
+    specs = flagship_param_specs(mesh, cfg)
+    n_out = cfg.batch * cfg.seq * cfg.model_dim
+
+    def block_fn(chunk_params, x):
+        return _stage_block(chunk_params, x, cfg, s_chunk, sp, tp, ep)
+
+    data_axes = _data_axes(axes)
+
+    def spec_axes(spec: P) -> set:
+        named = set()
+        for entry in tuple(spec):
+            if entry is None:
+                continue
+            named.update(entry if isinstance(entry, tuple) else (entry,))
+        return named
+
+    # Per-leaf gradient typing = the axes the param itself varies over
+    # (pp + its sharded dims). Everything else is already reduced:
+    # jax.vjp *inside* shard_map inserts the psum over any axis the
+    # primal doesn't vary on but the cotangent does — per tick, for
+    # dp/ep/sp data shards and the tp join alike — so the per-tick
+    # dchunk arrives fully cross-shard-summed (an explicit psum here
+    # was measured to exactly double dp gradients).
+    dparam_vma = {
+        k: ("pp",) + tuple(sorted(spec_axes(s) - {"pp"}))
+        for k, s in specs.items()
+    }
+
+    def step(params, x, target):
+        b_loc = x.shape[0]
+        if b_loc % cfg.microbatches:
+            raise ValueError(
+                f"local batch {b_loc} not divisible by "
+                f"{cfg.microbatches} microbatches"
+            )
+        mb = b_loc // cfg.microbatches
+        x_mb = x.reshape((cfg.microbatches, mb) + x.shape[1:])
+        t_mb = target.reshape((cfg.microbatches, mb) + target.shape[1:])
+        loss_sum, grads = interleaved_grads_local(
+            block_fn, _mse_loss_grad, params, x_mb, t_mb, sched, "pp",
+            chunk_rows=s_chunk, vma_axes=data_axes, dparam_vma=dparam_vma,
+        )
+        if data_axes:
+            loss_sum = jax.lax.psum(loss_sum, data_axes)
+        return _sgd_update(params, grads, lr, n_out), loss_sum / n_out
+
+    sm = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(specs, flagship_data_spec(mesh), flagship_data_spec(mesh)),
+        out_specs=(specs, P()),
+    )
+    return jax.jit(sm)

@@ -1,0 +1,196 @@
+"""Flagship config + mesh factoring (split from flagship.py, round 2).
+
+See :mod:`tpu_p2p.models.flagship` for the model overview. This module
+owns the five-axis vocabulary (``AXES``), the global-shape config, and
+the device-count → mesh factoring used by the driver entry points.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Dict
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from tpu_p2p.models.moe import MoEConfig
+
+AXES = ("dp", "pp", "sp", "tp", "ep")
+
+
+@dataclass(frozen=True)
+class FlagshipConfig:
+    """Global shapes; every dim must divide by its mesh axis size."""
+
+    batch: int = 8
+    seq: int = 256
+    heads: int = 8
+    kv_heads: int = 0    # 0 → same as heads (MHA); otherwise GQA/MQA:
+    # heads % kv_heads == 0, and under tp both counts must divide by
+    # the tp axis. The ring SP path then ships kv_heads/heads of the
+    # MHA bytes per ppermute hop.
+    head_dim: int = 32
+    stages: int = 2          # total pipeline stages (multiple of pp size)
+    microbatches: int = 2
+    num_experts: int = 4
+    capacity_factor: float = 2.0
+    moe_mult: int = 2        # expert FFN width = moe_mult * model_dim
+    causal: bool = True
+    dtype: str = "float32"   # compute dtype: activations and the
+    # in-block cast of params (bf16 puts the matmuls on the MXU's
+    # native path)
+    param_dtype: str = ""    # storage dtype for params ("" = same as
+    # dtype). param_dtype="float32" + dtype="bfloat16" is the classic
+    # mixed-precision recipe: f32 master weights (updates in f32 —
+    # _sgd_update/optax already do f32 math against the storage dtype),
+    # bf16 compute via a cast at block entry.
+    sp_strategy: str = "ring"  # "ring" (ppermute KV rotation),
+    # "ring_zigzag" (same transport, load-balanced causal layout — the
+    # model then treats its sequence axis as zigzag-ordered, see
+    # tpu_p2p.ops.attention.to_zigzag; attention is the only
+    # position-dependent op, so reordering the data suffices — exactly
+    # equivalent under no-drop MoE capacity, and with tight capacity
+    # the dropped-token set differs by shard co-location, like any
+    # resharding), or "ulysses" (head<->seq all_to_all). SURVEY.md
+    # §2.3's SP families; ulysses needs heads % sp == 0
+    zero_dp: bool = False    # ZeRO-3/FSDP: params (and thus grads +
+    # optimizer moments) sharded over dp, all-gathered on use inside
+    # the step; autodiff turns the gather's transpose into the ZeRO
+    # gradient reduce-scatter. See tpu_p2p/parallel/fsdp.py.
+    use_flash: bool = False  # Pallas flash kernel for the attention
+    # math, trainable under every sp_strategy: Ulysses sees the full
+    # sequence locally (the standalone custom-vjp kernel drops in);
+    # the ring paths ride tpu_p2p.ops.ring_flash — the FA2 block
+    # backward distributed over the same KV rotation ring.
+    rope: bool = False       # rotary position embeddings, applied to
+    # q/k per *global* position before any KV movement — so roped
+    # blocks rotate through the ring, reshard through Ulysses, or sit
+    # zigzag-permuted unchanged (tpu_p2p/ops/rope.py).
+    vocab: int = 0           # 0 = continuous regression (the default
+    # benchmark model); > 0 adds a tied token embedding ("emb",
+    # replicated) — inputs become int token ids, outputs logits, and
+    # make_flagship_lm_train_step trains with cross-entropy.
+    norm: bool = False       # pre-norm RMSNorm: learnable gains ln1
+    # (before attention) and ln2 (before the FFN) per stage, plus a
+    # final lnf before the LM unembed (vocab configs). Off by default
+    # so the benchmark model stays the bare composition of transports.
+    dense_ffn: bool = False  # replace the MoE FFN with a dense 2-layer
+    # gelu MLP (wf1/wf2), Megatron-sharded over tp (wf1 column-split,
+    # wf2 row-split, one psum join). num_experts/capacity_factor/ep are
+    # then unused — the ep mesh axis still shards data.
+    remat: bool = False      # rematerialize each transformer sub-block
+    # in the backward (jax.checkpoint): activation memory drops from
+    # O(layers) full-block residuals to O(layers) block inputs, the
+    # block recomputes in the bwd — the standard long-sequence
+    # FLOPs-for-HBM trade. Gradients are bit-identical either way.
+    attn_window: int = 0     # > 0: sliding-window (local) attention —
+    # each position attends to its last `attn_window` positions. Needs
+    # causal=True; works under every sp_strategy (ring paths window
+    # their block masks via global offsets, and ring hops whose KV
+    # block falls entirely outside the window cost no kernel work;
+    # full-sequence flash views use the banded kernels).
+
+    def __post_init__(self) -> None:
+        # Strict, because a typo ("zigzag", "ring-zigzag") would fall
+        # through to the contiguous layout and train silently wrong on
+        # zigzag-permuted data.
+        if self.sp_strategy not in ("ring", "ring_zigzag", "ulysses"):
+            raise ValueError(
+                f"unknown sp_strategy {self.sp_strategy!r}; expected "
+                "'ring', 'ring_zigzag', or 'ulysses'"
+            )
+        if self.attn_window < 0:
+            raise ValueError(
+                f"attn_window must be >= 0, got {self.attn_window}"
+            )
+        if self.attn_window and not self.causal:
+            raise ValueError("attn_window requires causal=True")
+
+    @property
+    def model_dim(self) -> int:
+        return self.heads * self.head_dim
+
+    @property
+    def params_dtype(self) -> str:
+        return self.param_dtype or self.dtype
+
+    @property
+    def num_kv_heads(self) -> int:
+        return self.kv_heads or self.heads
+
+    def moe(self) -> MoEConfig:
+        return MoEConfig(
+            d_model=self.model_dim, d_ff=self.moe_mult * self.model_dim,
+            num_experts=self.num_experts,
+            capacity_factor=self.capacity_factor,
+        )
+
+    def tiny(self, mesh: Mesh) -> "FlagshipConfig":
+        """Shrink to dryrun scale while keeping every axis shardable."""
+        ax = dict(zip(mesh.axis_names, mesh.devices.shape))
+        tp, sp, pp = ax.get("tp", 1), ax.get("sp", 1), ax.get("pp", 1)
+        dpep = ax.get("dp", 1) * ax.get("ep", 1)
+        heads = 2 * tp * sp
+        # Preserve the GQA ratio when it still yields a valid KV head
+        # count at the shrunken query head count (divisible, tp-
+        # shardable); otherwise fall back to MHA rather than produce
+        # kv_heads > heads or a non-dividing group.
+        ratio = self.heads // self.num_kv_heads
+        kv = heads // ratio if heads % ratio == 0 else 0
+        if kv and (heads % kv or kv % tp):
+            kv = 0
+        return replace(
+            self,
+            batch=2 * dpep * self.microbatches,
+            seq=16 * sp,
+            heads=heads,  # divisible by tp AND sp, so either SP
+            # strategy (ring or ulysses) shards cleanly
+            kv_heads=kv,
+            head_dim=8,
+            stages=pp,
+            num_experts=2 * ax.get("ep", 1),
+            capacity_factor=float(2 * ax.get("ep", 1)),  # no-drop capacity
+        )
+
+
+def _axis(mesh: Mesh, name: str):
+    return name if name in mesh.axis_names else None
+
+
+def _data_axes(axes) -> tuple:
+    """The axes data (and thus loss/grad partial sums) shard over."""
+    return tuple(a for a in ("dp", "ep", "sp") if a in axes)
+
+
+def _mesh_axes(mesh: Mesh) -> Dict[str, str]:
+    return {a: a for a in AXES if a in mesh.axis_names}
+
+
+def build_mesh(n_devices: int, devices=None) -> Mesh:
+    """Factor ``n_devices`` over the five named axes.
+
+    Priority order sp → dp → pp → tp → ep (sp is the flagship axis;
+    tp/ep want fast links and forgive size-1). Axes that receive no
+    factor stay size 1 — every collective still compiles, so the
+    program shape is identical from 1 chip to a pod.
+    """
+    if devices is None:
+        devices = jax.devices()
+    assert len(devices) >= n_devices, (
+        f"need {n_devices} devices, have {len(devices)}"
+    )
+    factors = []
+    m = n_devices
+    for p in (2, 3, 5, 7, 11, 13):
+        while m % p == 0:
+            factors.append(p)
+            m //= p
+    if m > 1:
+        factors.append(m)
+    dims = {a: 1 for a in AXES}
+    order = ["sp", "dp", "pp", "tp", "ep"]
+    for i, f in enumerate(sorted(factors, reverse=True)):
+        dims[order[i % len(order)]] *= f
+    shape = tuple(dims[a] for a in AXES)
+    return Mesh(np.array(devices[:n_devices]).reshape(shape), AXES)

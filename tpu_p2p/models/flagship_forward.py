@@ -1,0 +1,235 @@
+"""Flagship forward path: transformer blocks over the 5-axis mesh.
+
+Split from flagship.py (round 2); see :mod:`tpu_p2p.models.flagship`
+for the model overview. This module owns everything traced inside the
+forward — the per-stage transformer block (ring/Ulysses sp attention,
+Megatron tp psum, MoE ep all_to_all), the GPipe microbatch schedule,
+and the jitted forward builders (regression and LM).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_p2p.models.flagship_config import (
+    AXES,
+    FlagshipConfig,
+    _mesh_axes,
+)
+from tpu_p2p.models.flagship_params import (
+    Params,
+    _fsdp_plan,
+    _lm_token_spec,
+    flagship_data_spec,
+    flagship_param_specs,
+)
+from tpu_p2p.models.moe import moe_layer_local
+from tpu_p2p.models.pipeline import pipeline_apply_local
+from tpu_p2p.ops.attention import dense_attention, ring_attention_local
+
+
+def _rms_norm(x, gain, eps: float = 1e-6):
+    """RMSNorm in float32 with a learnable gain; RMSNorm(0) == 0, so
+    pipeline bubble ticks stay inert through normed blocks."""
+    xf = x.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * r * gain.astype(jnp.float32)).astype(x.dtype)
+
+
+def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
+    """One transformer block: attention + FFN (MoE or dense), both
+    residual, optionally pre-normed (``cfg.norm``).
+
+    ``sub_params`` leaves are one stage's slice (no stage dim).
+    ``x``: local shard ``[mb_loc, T_loc, Dm]``. Zero input → zero
+    output, which keeps pipeline bubble ticks inert.
+    """
+    h = _rms_norm(x, sub_params["ln1"]) if cfg.norm else x
+    q = jnp.einsum("btm,hmd->bhtd", h, sub_params["wq"])
+    k = jnp.einsum("btm,hmd->bhtd", h, sub_params["wk"])
+    v = jnp.einsum("btm,hmd->bhtd", h, sub_params["wv"])
+    sp_size = jax.lax.axis_size(sp) if sp is not None else 1
+    layout = "zigzag" if cfg.sp_strategy == "ring_zigzag" else "contiguous"
+    if cfg.rope:
+        from tpu_p2p.ops.attention import _block_positions
+        from tpu_p2p.ops.rope import apply_rope
+
+        t_loc = x.shape[1]
+        if sp is None or sp_size == 1:
+            positions = jnp.arange(t_loc)
+        else:
+            positions = _block_positions(
+                jax.lax.axis_index(sp), sp_size, t_loc, layout
+            )
+        q = apply_rope(q, positions)
+        k = apply_rope(k, positions)
+    window = cfg.attn_window or None
+    if sp is not None and cfg.sp_strategy == "ulysses":
+        from tpu_p2p.ops.ulysses import ulysses_attention_local
+
+        a = ulysses_attention_local(q, k, v, sp, causal=cfg.causal,
+                                    use_flash=cfg.use_flash, window=window)
+    elif sp is not None and sp_size > 1:
+        a = ring_attention_local(q, k, v, sp, causal=cfg.causal,
+                                 use_flash=cfg.use_flash, layout=layout,
+                                 window=window)
+    elif cfg.use_flash:  # size-1 sp (or no sp axis): sequence is local
+        from tpu_p2p.ops.flash_attention import flash_attention
+
+        a = flash_attention(q, k, v, cfg.causal, window)
+    else:
+        a = dense_attention(q, k, v, causal=cfg.causal, window=window)
+    y = jnp.einsum("bhtd,hdm->btm", a, sub_params["wo"])
+    if tp is not None:
+        y = jax.lax.psum(y, tp)  # Megatron join of head shards
+    x = x + y
+    h2 = _rms_norm(x, sub_params["ln2"]) if cfg.norm else x
+    if cfg.dense_ffn:
+        return x + _dense_ffn(sub_params, h2, tp)
+    # MoE FFN over flattened local tokens.
+    moe_params = {k2: sub_params[k2] for k2 in ("router",)}
+    moe_params["w1"], moe_params["w2"] = sub_params["we1"], sub_params["we2"]
+    tokens = h2.reshape(-1, h2.shape[-1])
+    m_out = moe_layer_local(moe_params, tokens, cfg.moe(), ep_axis=ep)
+    return x + m_out.reshape(x.shape)
+
+
+def _dense_ffn(sub_params: Params, h, tp):
+    """Dense 2-layer gelu MLP, Megatron-sharded over ``tp``: wf1 holds
+    a column (hidden) shard, wf2 the matching row shard, and one psum
+    joins the partial outputs. gelu(0) == 0 keeps bubbles inert."""
+    f_h = jax.nn.gelu(jnp.einsum("btm,mf->btf", h, sub_params["wf1"],
+                                 preferred_element_type=jnp.float32))
+    f_out = jnp.einsum("btf,fm->btm", f_h, sub_params["wf2"],
+                       preferred_element_type=jnp.float32)
+    if tp is not None:
+        f_out = jax.lax.psum(f_out, tp)
+    return f_out.astype(h.dtype)
+
+
+def _stage_block(stage_params: Params, x, cfg: FlagshipConfig,
+                 s_local: int, sp, tp, ep):
+    """Apply this pp rank's ``s_local`` consecutive sub-blocks."""
+    compute = jnp.dtype(cfg.dtype)
+
+    def cast_and_run(sub, x, cfg, sp, tp, ep):
+        # Mixed precision: params stored in params_dtype are cast to
+        # the compute dtype at block entry (autodiff transposes the
+        # cast, so grads flow back to the storage-dtype masters).
+        # Inside the remat boundary on purpose: checkpointed-call
+        # inputs stay live until the stage's backward, so casting
+        # outside would pin a compute-dtype copy of every stage's
+        # params — recomputing the cast from the masters is free.
+        sub = {k: v.astype(compute) if v.dtype != compute else v
+               for k, v in sub.items()}
+        return _stage_sub_block(sub, x, cfg, sp, tp, ep)
+
+    body = cast_and_run
+    if cfg.remat:
+        # Per-block rematerialization: save only each block's input,
+        # recompute the block inside the backward.
+        body = jax.checkpoint(cast_and_run, static_argnums=(2, 3, 4, 5))
+    for i in range(s_local):
+        sub = {k: v[i] for k, v in stage_params.items()}
+        x = body(sub, x, cfg, sp, tp, ep)
+    return x
+
+
+def _pipeline_schedule(stage_params, x_mb, cfg, s_local, pp, sp, tp, ep):
+    """GPipe ticks over the pp axis — delegates to
+    :func:`tpu_p2p.models.pipeline.pipeline_apply_local` with the
+    transformer stage block; ``pp=None`` runs the stages sequentially."""
+    def block_fn(params, x):
+        return _stage_block(params, x, cfg, s_local, sp, tp, ep)
+
+    if pp is None:
+        return jnp.stack(
+            [block_fn(stage_params, x_mb[i]) for i in range(x_mb.shape[0])]
+        )
+    return pipeline_apply_local(block_fn, stage_params, x_mb, pp)
+
+
+def _forward_local(params, x, cfg: FlagshipConfig, mesh_axes):
+    dp, pp, sp, tp, ep = (mesh_axes.get(a) for a in AXES)
+    del dp
+    pp_size = jax.lax.axis_size(pp) if pp is not None else 1
+    if cfg.stages % pp_size:
+        raise ValueError(
+            f"stages ({cfg.stages}) must divide by pp size ({pp_size})"
+        )
+    s_local = cfg.stages // pp_size
+    b_loc = x.shape[0]
+    if b_loc % cfg.microbatches:
+        raise ValueError(
+            f"local batch {b_loc} not divisible by "
+            f"{cfg.microbatches} microbatches"
+        )
+    x_mb = x.reshape((cfg.microbatches, b_loc // cfg.microbatches)
+                     + x.shape[1:])
+    y_mb = _pipeline_schedule(params, x_mb, cfg, s_local, pp, sp, tp, ep)
+    return y_mb.reshape(x.shape)
+
+
+def make_flagship_forward(mesh: Mesh, cfg: FlagshipConfig):
+    """Jitted forward over the 5-axis mesh: global [B, T, Dm] → same."""
+    from tpu_p2p.parallel import fsdp
+
+    axes = _mesh_axes(mesh)
+    plan = _fsdp_plan(mesh, cfg)
+
+    def f(params, x):
+        if plan:
+            params = fsdp.all_gather_params(params, "dp", plan)
+        return _forward_local(params, x, cfg, axes)
+
+    sm = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(flagship_param_specs(mesh, cfg), flagship_data_spec(mesh)),
+        out_specs=flagship_data_spec(mesh),
+    )
+    return jax.jit(sm)
+
+
+def _lm_logits_local(params, tokens, cfg: FlagshipConfig, axes):
+    """Embed → transformer stack → tied unembed, per shard — the one
+    definition of the LM head, shared by the forward and the train
+    step so the reported loss can never diverge from the forward's
+    logits. Embedding and unembedding are position-independent, so
+    they sit outside the pipeline schedule (every pp rank computes
+    them on the replicated activations)."""
+    x = jnp.take(params["emb"], tokens, axis=0).astype(jnp.dtype(cfg.dtype))
+    # The stack sees only stage-major leaves: _stage_block slices every
+    # leaf by stage index; emb (vocab-leading) and lnf (stage-less) are
+    # applied here around it.
+    stack = {k: v for k, v in params.items() if k not in ("emb", "lnf")}
+    y = _forward_local(stack, x, cfg, axes)
+    if cfg.norm:
+        y = _rms_norm(y, params["lnf"])
+    return jnp.einsum("btm,vm->btv", y.astype(jnp.float32),
+                      params["emb"].astype(jnp.float32))
+
+
+def make_flagship_lm_forward(mesh: Mesh, cfg: FlagshipConfig):
+    """Jitted LM forward: global token ids ``[B, T]`` → logits
+    ``[B, T, vocab]``."""
+    from tpu_p2p.parallel import fsdp
+
+    if not cfg.vocab:
+        raise ValueError("cfg.vocab must be > 0 for the LM forward")
+    axes = _mesh_axes(mesh)
+    plan = _fsdp_plan(mesh, cfg)
+
+    def f(params, tokens):
+        if plan:
+            params = fsdp.all_gather_params(params, "dp", plan)
+        return _lm_logits_local(params, tokens, cfg, axes)
+
+    tok_spec = _lm_token_spec(mesh)
+    sm = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(flagship_param_specs(mesh, cfg), tok_spec),
+        out_specs=P(*tuple(tok_spec), None),
+    )
+    return jax.jit(sm)

@@ -1,0 +1,182 @@
+"""Flagship parameters: shapes, init, shardings, placement, batches.
+
+Split from flagship.py (round 2); see :mod:`tpu_p2p.models.flagship`
+for the model overview. Everything here is static metadata or host→
+device placement — no traced computation.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_p2p.models.flagship_config import FlagshipConfig, _axis
+
+Params = Dict[str, jax.Array]
+
+
+def flagship_param_shapes(cfg: FlagshipConfig) -> Dict[str, Tuple[int, ...]]:
+    """Parameter shapes from the config alone (no initialization) —
+    feeds the static FSDP plan and checkpoint metadata."""
+    s, h, hkv = cfg.stages, cfg.heads, cfg.num_kv_heads
+    dm, dh = cfg.model_dim, cfg.head_dim
+    e, f = cfg.num_experts, cfg.moe_mult * cfg.model_dim
+    shapes = {
+        "wq": (s, h, dm, dh),
+        "wk": (s, hkv, dm, dh),
+        "wv": (s, hkv, dm, dh),
+        "wo": (s, h, dh, dm),
+    }
+    if cfg.dense_ffn:
+        shapes["wf1"] = (s, dm, f)
+        shapes["wf2"] = (s, f, dm)
+    else:
+        shapes["router"] = (s, dm, e)
+        shapes["we1"] = (s, e, dm, f)
+        shapes["we2"] = (s, e, f, dm)
+    if cfg.norm:
+        shapes["ln1"] = (s, dm)
+        shapes["ln2"] = (s, dm)
+        if cfg.vocab:
+            shapes["lnf"] = (dm,)
+    if cfg.vocab:
+        shapes["emb"] = (cfg.vocab, dm)
+    return shapes
+
+
+_FAN_IN_DIM = {"wq": 2, "wk": 2, "wv": 2, "wo": 2, "router": 1,
+               "we1": 2, "we2": 2, "emb": 1, "wf1": 1, "wf2": 1}
+_GAIN_PARAMS = ("ln1", "ln2", "lnf")  # RMSNorm gains: init to ones
+
+
+def init_flagship_params(cfg: FlagshipConfig, seed: int = 0) -> Params:
+    rng = np.random.default_rng(seed)
+    dtype = jnp.dtype(cfg.params_dtype)
+    return {
+        name: (
+            jnp.ones(shape, dtype)
+            if name in _GAIN_PARAMS
+            else jnp.asarray(
+                rng.standard_normal(shape)
+                / math.sqrt(shape[_FAN_IN_DIM[name]]),
+                dtype=dtype,
+            )
+        )
+        for name, shape in flagship_param_shapes(cfg).items()
+    }
+
+
+def _base_param_specs(mesh: Mesh) -> Dict[str, P]:
+    pp, tp, ep = _axis(mesh, "pp"), _axis(mesh, "tp"), _axis(mesh, "ep")
+    return {
+        "wq": P(pp, tp, None, None),
+        "wk": P(pp, tp, None, None),
+        "wv": P(pp, tp, None, None),
+        "wo": P(pp, tp, None, None),
+        "router": P(pp, None, None),
+        "we1": P(pp, ep, None, None),
+        "we2": P(pp, ep, None, None),
+        "wf1": P(pp, None, tp),   # dense FFN, Megatron column split
+        "wf2": P(pp, tp, None),   # …row split; psum joins the output
+        "ln1": P(pp, None),
+        "ln2": P(pp, None),
+        "lnf": P(None),
+        "emb": P(None, None),  # tied embedding (vocab > 0); replicated
+        # (ZeRO may still dp-shard it via the plan). Extra keys are
+        # harmless for configs without a vocab.
+    }
+
+
+def _fsdp_plan(mesh: Mesh, cfg: Optional[FlagshipConfig]):
+    """The static ZeRO plan, or None when FSDP is off / inapplicable."""
+    from tpu_p2p.parallel import fsdp
+
+    if cfg is None or not cfg.zero_dp or _axis(mesh, "dp") is None:
+        return None
+    plan = fsdp.fsdp_plan(
+        flagship_param_shapes(cfg), _base_param_specs(mesh),
+        mesh.shape["dp"],
+    )
+    return plan if any(d is not None for d in plan.values()) else None
+
+
+def flagship_param_specs(mesh: Mesh,
+                         cfg: Optional[FlagshipConfig] = None) -> Dict[str, P]:
+    """Param shardings: pp stage-major, tp heads, ep experts — plus the
+    dp dim from the ZeRO plan when ``cfg.zero_dp`` is set. The result's
+    keys mirror the params pytree: ``emb`` only with a vocab."""
+    from tpu_p2p.parallel import fsdp
+
+    base = _base_param_specs(mesh)
+    plan = _fsdp_plan(mesh, cfg)
+    specs = fsdp.fsdp_specs(base, plan, "dp") if plan else base
+    if cfg is not None:
+        # shard_map in_specs must mirror the params pytree exactly —
+        # keep only the keys this config's shapes actually produce.
+        specs = {k: specs[k] for k in flagship_param_shapes(cfg)}
+    else:
+        # No config: every stage-major leaf (pipelined placement looks
+        # specs up per param key); the stage-less leaves are excluded.
+        specs = {k: v for k, v in specs.items() if k not in ("emb", "lnf")}
+    return specs
+
+
+def flagship_data_spec(mesh: Mesh) -> P:
+    """Batch sharded jointly over (dp, ep); sequence over sp."""
+    dp, ep, sp = _axis(mesh, "dp"), _axis(mesh, "ep"), _axis(mesh, "sp")
+    batch_axes = tuple(a for a in (dp, ep) if a is not None)
+    return P(batch_axes if batch_axes else None, sp, None)
+
+
+def _lm_token_spec(mesh: Mesh) -> P:
+    """Token ids ``[B, T]``: batch over dp/ep, sequence over sp."""
+    dp, ep, sp = _axis(mesh, "dp"), _axis(mesh, "ep"), _axis(mesh, "sp")
+    batch_axes = tuple(a for a in (dp, ep) if a is not None)
+    return P(batch_axes if batch_axes else None, sp)
+
+
+def place_flagship_params(params: Params, mesh: Mesh,
+                          cfg: Optional[FlagshipConfig] = None) -> Params:
+    specs = flagship_param_specs(mesh, cfg)
+    base = _base_param_specs(mesh)  # covers the stage-less leaves
+    # (emb, lnf) when no cfg narrows the spec set
+    return {k: jax.device_put(v, NamedSharding(mesh, specs.get(k, base[k])))
+            for k, v in params.items()}
+
+
+def flagship_host_batch(cfg: FlagshipConfig, rng) -> Tuple:
+    """One host-side ``(x, target)`` batch — the single source of the
+    flagship batch shape/dtype, shared by :func:`flagship_example_batch`
+    and :func:`tpu_p2p.utils.data.flagship_loader`."""
+    shape = (cfg.batch, cfg.seq, cfg.model_dim)
+    dtype = jnp.dtype(cfg.dtype)
+    return (rng.standard_normal(shape).astype(dtype),
+            rng.standard_normal(shape).astype(dtype))
+
+
+def flagship_example_batch(cfg: FlagshipConfig, mesh: Mesh = None,
+                           seed: int = 1) -> Tuple:
+    x, t = flagship_host_batch(cfg, np.random.default_rng(seed))
+    x, t = jnp.asarray(x), jnp.asarray(t)
+    if mesh is not None:
+        sharding = NamedSharding(mesh, flagship_data_spec(mesh))
+        x, t = jax.device_put(x, sharding), jax.device_put(t, sharding)
+    return x, t
+
+
+def flagship_token_batch(cfg: FlagshipConfig, mesh: Mesh = None,
+                         seed: int = 1) -> Tuple:
+    """Random ``(tokens, next-token targets)`` int32 batches."""
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, cfg.vocab, (cfg.batch, cfg.seq + 1))
+    x = jnp.asarray(toks[:, :-1], jnp.int32)
+    t = jnp.asarray(toks[:, 1:], jnp.int32)
+    if mesh is not None:
+        sharding = NamedSharding(mesh, _lm_token_spec(mesh))
+        x, t = jax.device_put(x, sharding), jax.device_put(t, sharding)
+    return x, t

@@ -1,0 +1,219 @@
+"""Flagship train steps: SGD, LM cross-entropy, optax.
+
+Split from flagship.py (round 2); see :mod:`tpu_p2p.models.flagship`
+for the model overview. Each builder returns one jitted step whose
+gradient reductions are implicit in ``shard_map`` autodiff; the manual
+1F1B executor lives in :mod:`tpu_p2p.models.flagship_1f1b`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_p2p.models.flagship_config import (
+    FlagshipConfig,
+    _data_axes,
+    _mesh_axes,
+)
+from tpu_p2p.models.flagship_forward import (
+    _forward_local,
+    _lm_logits_local,
+)
+from tpu_p2p.models.flagship_params import (
+    Params,
+    _fsdp_plan,
+    _lm_token_spec,
+    flagship_data_spec,
+    flagship_param_specs,
+)
+
+
+def _sgd_update(params: Params, grads, lr: float, denom: float):
+    """`p - lr*g/denom` elementwise in f32, cast back to each param's
+    dtype — the one SGD update shared by every train-step flavor."""
+    return jax.tree.map(
+        lambda p, g: (p.astype(jnp.float32)
+                      - lr * g / denom).astype(p.dtype),
+        params, grads,
+    )
+
+
+def make_flagship_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
+    """Jitted ``(params, x, target) → (grads, loss)`` over the mesh.
+
+    Loss is the global sum of squared error; gradient reductions are
+    implicit in ``shard_map`` autodiff (see
+    :mod:`tpu_p2p.models.ring_transformer` for the accounting). Grads
+    come back sharded exactly like the params, so any optimizer's
+    elementwise update runs shard-local under ``jit``.
+    """
+    from tpu_p2p.parallel import fsdp
+
+    axes = _mesh_axes(mesh)
+    plan = _fsdp_plan(mesh, cfg)
+    specs = flagship_param_specs(mesh, cfg)
+
+    def gstep(params, x, target):
+        def local_loss(p):
+            # ZeRO gather-on-use sits inside the differentiated
+            # function: its transpose is the gradient psum_scatter, so
+            # grads come back dp-sharded like the params.
+            if plan:
+                p = fsdp.all_gather_params(p, "dp", plan)
+            out = _forward_local(p, x, cfg, axes)
+            return jnp.sum(
+                (out.astype(jnp.float32) - target.astype(jnp.float32)) ** 2
+            )
+
+        loss, grads = jax.value_and_grad(local_loss)(params)
+        # Sum the partial losses over every data-sharded axis; pp/tp
+        # replicas are typed replicated and count once.
+        data_axes = _data_axes(axes)
+        if data_axes:
+            loss = jax.lax.psum(loss, data_axes)
+        return grads, loss
+
+    sm = jax.shard_map(
+        gstep, mesh=mesh,
+        in_specs=(specs, flagship_data_spec(mesh), flagship_data_spec(mesh)),
+        out_specs=(specs, P()),
+    )
+    return jax.jit(sm)
+
+
+def make_flagship_train_step(mesh: Mesh, cfg: FlagshipConfig,
+                             lr: float = 1e-2, donate: bool = False):
+    """One jitted SGD step: forward, backward, update.
+
+    ``donate=True`` donates the incoming params to the step so XLA
+    updates them in place (halves param HBM traffic and peak param
+    memory) — the caller must then treat the passed params as consumed
+    (``params, loss = step(params, ...)``) and never reuse the old
+    reference, so it is opt-in.
+    """
+    grad_fn = make_flagship_grad_fn(mesh, cfg)
+    n_out = cfg.batch * cfg.seq * cfg.model_dim
+
+    def step(params, x, target):
+        grads, loss = grad_fn(params, x, target)
+        return _sgd_update(params, grads, lr, n_out), loss / n_out
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_flagship_lm_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
+    """Jitted ``(params, tokens, targets) → (grads, summed CE)`` —
+    the LM twin of :func:`make_flagship_grad_fn` (same contract: raw
+    global-sum loss and grads; step builders own the normalization)."""
+    from tpu_p2p.parallel import fsdp
+
+    if not cfg.vocab:
+        raise ValueError("cfg.vocab must be > 0 for the LM step")
+    axes = _mesh_axes(mesh)
+    plan = _fsdp_plan(mesh, cfg)
+    specs = flagship_param_specs(mesh, cfg)
+
+    def gstep(params, tokens, targets):
+        def local_loss(p):
+            pf = fsdp.all_gather_params(p, "dp", plan) if plan else p
+            logits = _lm_logits_local(pf, tokens, cfg, axes)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, targets[..., None],
+                                       axis=-1)[..., 0]
+            return jnp.sum(nll)
+
+        loss, grads = jax.value_and_grad(local_loss)(params)
+        data_axes = _data_axes(axes)
+        if data_axes:
+            loss = jax.lax.psum(loss, data_axes)
+        return grads, loss
+
+    tok_spec = _lm_token_spec(mesh)
+    sm = jax.shard_map(
+        gstep, mesh=mesh,
+        in_specs=(specs, tok_spec, tok_spec),
+        out_specs=(specs, P()),
+    )
+    return jax.jit(sm)
+
+
+def make_flagship_lm_train_step(mesh: Mesh, cfg: FlagshipConfig,
+                                lr: float = 1e-2, donate: bool = False):
+    """One jitted SGD step on next-token cross-entropy.
+
+    ``(params, tokens [B, T], targets [B, T]) → (params, mean CE)``
+    (the caller shifts targets). Gradient reductions are implicit in
+    shard_map autodiff, exactly as in the regression step. ``donate``
+    as in :func:`make_flagship_train_step` (params updated in place;
+    callers must reassign).
+    """
+    grad_fn = make_flagship_lm_grad_fn(mesh, cfg)
+    n_tok = cfg.batch * cfg.seq
+
+    def step(params, tokens, targets):
+        grads, loss = grad_fn(params, tokens, targets)
+        return _sgd_update(params, grads, lr, n_tok), loss / n_tok
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_flagship_optax_step(mesh: Mesh, cfg: FlagshipConfig, tx,
+                             lm: bool = False, donate: bool = False):
+    """One jitted step under any optax ``GradientTransformation``.
+
+    ``(params, opt_state, x, target) → (params, opt_state, loss)``.
+    The optimizer math is plain elementwise jit outside the shard_map:
+    XLA propagates the param/grad shardings into the update, so mu/nu
+    moments shard exactly like their params. Initialize with
+    :func:`init_optimizer`. ``lm=True`` trains next-token CE on token
+    batches (``cfg.vocab > 0``); ``donate`` donates params AND opt
+    state (callers must reassign both).
+    """
+    import optax
+
+    if lm:
+        grad_fn = make_flagship_lm_grad_fn(mesh, cfg)
+        n_out = cfg.batch * cfg.seq
+    else:
+        grad_fn = make_flagship_grad_fn(mesh, cfg)
+        n_out = cfg.batch * cfg.seq * cfg.model_dim
+
+    def step(params, opt_state, x, target):
+        grads, loss = grad_fn(params, x, target)
+        grads = jax.tree.map(lambda g: g / n_out, grads)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss / n_out
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def init_optimizer(tx, params: Params):
+    """``tx.init`` with the optimizer state explicitly sharded like the
+    params: per-param moments (mu/nu/trace…) get that param's sharding,
+    everything else (step counts) is replicated. jit alone does NOT do
+    this — sharding propagation through a broadcast-of-zeros picks a
+    default placement, which would silently replicate ZeRO moments.
+
+    Leaves are matched to params by tree path: optax state subtrees
+    mirror the params dict, so the innermost dict key naming a param
+    (with matching shape) identifies its sharding.
+    """
+    shardings = {k: getattr(v, "sharding", None) for k, v in params.items()}
+    if any(not isinstance(s, NamedSharding) for s in shardings.values()):
+        return jax.jit(tx.init)(params)  # unplaced params: plain init
+    mesh = next(iter(shardings.values())).mesh
+    replicated = NamedSharding(mesh, P())
+
+    def leaf_sharding(path, leaf):
+        for entry in reversed(path):
+            name = getattr(entry, "key", None)
+            if name in params and leaf.shape == params[name].shape:
+                return shardings[name]
+        return replicated
+
+    shapes = jax.eval_shape(tx.init, params)
+    out_shardings = jax.tree_util.tree_map_with_path(leaf_sharding, shapes)
+    return jax.jit(tx.init, out_shardings=out_shardings)(params)
