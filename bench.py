@@ -469,6 +469,37 @@ def main() -> int:
             print(f"# decode measurement failed: {e!r}", file=sys.stderr)
             decode = {"decode_ms_per_token": None,
                       "decode_tokens_per_s": None}
+        # Self-validate the timing method in the graded artifact: the
+        # device-trace slope (XLA's own timeline — no relay, no host
+        # jitter) cross-checks the host differential the numbers above
+        # rest on. Validates the SAME 256 MiB buffer the headline
+        # number measures: smaller payloads sit VMEM-resident (a
+        # 16 MiB rewrite is ~14 µs on-device), leaving the long-short
+        # delta inside the relay's ±5 ms jitter — this one's ~70 ms
+        # delta is unambiguous. ok=None when no device track exists.
+        try:
+            import tempfile
+
+            from tpu_p2p.utils.profiling import validate_differential
+
+            with tempfile.TemporaryDirectory(prefix="bench_vt_") as td:
+                tv = validate_differential(
+                    lambda k: cache.loopback_chain(rt.mesh, k),
+                    xb, iters, trace_dir=td, repeats=5,
+                )
+            timing_validation = {
+                "ok": tv.ok,
+                "host_us_per_op": round(tv.host_per_op_s * 1e6, 3),
+                "device_us_per_op": (
+                    round(tv.device_per_op_s * 1e6, 3)
+                    if tv.device_per_op_s is not None else None
+                ),
+                "ratio": (round(tv.ratio, 3)
+                          if tv.ratio is not None else None),
+            }
+        except Exception as e:  # noqa: BLE001 — diagnostic, not a metric
+            print(f"# timing validation failed: {e!r}", file=sys.stderr)
+            timing_validation = {"ok": None}
         result = {
             "metric": "loopback_hbm_rewrite_bandwidth",
             "value": round(float(value), 3),
@@ -494,6 +525,7 @@ def main() -> int:
                 **decode,
                 "mode": "differential",
                 "block_fence_trustworthy": fence_ok,
+                "timing_validation": timing_validation,
                 "baseline_anchor": {
                     "name": "v5e_hbm_peak",
                     "value_gbytes_per_s": V5E_HBM_GBYTES_PER_S,

@@ -254,3 +254,6 @@ def test_main_single_chip_branch_schema(capsys, monkeypatch):
     assert "stubbed" in cap.err
     # Latency: a real (cheap, 8-byte) measurement ran — either shape.
     assert "latency_8b_p50_us" in d
+    # Timing self-validation ran; the CPU platform records no device
+    # track, so it must report unjudged (None), never a false verdict.
+    assert d["timing_validation"]["ok"] is None
