@@ -266,6 +266,34 @@ def _select_pairs(all_pairs, max_pairs):
     return all_pairs[::stride][:max_pairs]
 
 
+def _run_timing_validation(chain_of, payload, iters) -> dict:
+    """Cross-check the host differential slope against the device
+    trace on the given chain, returning JSON-ready fields (ok=None on
+    platforms recording no device track, or on any failure — the
+    validation is diagnostic, never a reason to lose the metrics)."""
+    import tempfile
+
+    from tpu_p2p.utils import timing
+    from tpu_p2p.utils.profiling import validate_differential
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="bench_vt_") as td:
+            tv = validate_differential(chain_of, payload, iters,
+                                       trace_dir=td, repeats=5)
+    except Exception as e:  # noqa: BLE001
+        print(f"# timing validation failed: {e!r}", file=sys.stderr)
+        return {"ok": None}
+    return {
+        "ok": tv.ok,
+        "host_us_per_op": round(tv.host_per_op_s * 1e6, 3),
+        "device_us_per_op": (
+            round(tv.device_per_op_s * 1e6, 3)
+            if tv.device_per_op_s is not None else None
+        ),
+        "ratio": round(tv.ratio, 3) if tv.ratio is not None else None,
+    }
+
+
 def _latency_8b(timing, chain_of, payload):
     """p50 device-side per-op latency on an 8-byte buffer.
 
@@ -387,6 +415,13 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             print(f"# latency measurement failed: {e!r}", file=sys.stderr)
             lat = {"latency_8b_p50_us": None}
+        # Same timing self-validation as the single-chip branch, on a
+        # ring chain over the full mesh (the collective family the
+        # matrix numbers are built from).
+        timing_validation = _run_timing_validation(
+            lambda k: cache.permute_chain(rt.mesh, "d", C.ring_edges(n), k),
+            x, 32,
+        )
         result = {
             "metric": "all_pairs_unidir_bandwidth_avg",
             "value": round(value, 3),
@@ -405,6 +440,7 @@ def main() -> int:
                 **lat,
                 "mode": "differential",
                 "block_fence_trustworthy": fence_ok,
+                "timing_validation": timing_validation,
                 "baseline_anchor": {
                     "name": "nccl_a100_nvlink3_p2p",
                     "value_gbps": NVLINK_A100_GBPS,
@@ -477,29 +513,9 @@ def main() -> int:
         # 16 MiB rewrite is ~14 µs on-device), leaving the long-short
         # delta inside the relay's ±5 ms jitter — this one's ~70 ms
         # delta is unambiguous. ok=None when no device track exists.
-        try:
-            import tempfile
-
-            from tpu_p2p.utils.profiling import validate_differential
-
-            with tempfile.TemporaryDirectory(prefix="bench_vt_") as td:
-                tv = validate_differential(
-                    lambda k: cache.loopback_chain(rt.mesh, k),
-                    xb, iters, trace_dir=td, repeats=5,
-                )
-            timing_validation = {
-                "ok": tv.ok,
-                "host_us_per_op": round(tv.host_per_op_s * 1e6, 3),
-                "device_us_per_op": (
-                    round(tv.device_per_op_s * 1e6, 3)
-                    if tv.device_per_op_s is not None else None
-                ),
-                "ratio": (round(tv.ratio, 3)
-                          if tv.ratio is not None else None),
-            }
-        except Exception as e:  # noqa: BLE001 — diagnostic, not a metric
-            print(f"# timing validation failed: {e!r}", file=sys.stderr)
-            timing_validation = {"ok": None}
+        timing_validation = _run_timing_validation(
+            lambda k: cache.loopback_chain(rt.mesh, k), xb, iters,
+        )
         result = {
             "metric": "loopback_hbm_rewrite_bandwidth",
             "value": round(float(value), 3),

@@ -163,6 +163,8 @@ def test_main_multichip_branch_schema(capsys, monkeypatch):
     assert d["min_gbps"] <= r["value"] <= d["max_gbps"]
     assert d["baseline_anchor"]["name"] == "nccl_a100_nvlink3_p2p"
     assert len(d["latency_pair"]) == 2
+    # Timing self-validation present; CPU mesh has no device track.
+    assert d["timing_validation"]["ok"] is None
     # Latency fields present in one of the two shapes (resolved/bound).
     assert "latency_8b_p50_us" in d
     if d["latency_8b_p50_us"] is None and "latency_8b_us_upper_bound" in d:

@@ -256,3 +256,44 @@ def test_negative_top_k_rejected():
         D.generate_tokens(step, params, cache, toks[:, :4], num_tokens=2,
                           temperature=1.0, top_k=-5,
                           rng=jax.random.PRNGKey(0))
+
+
+def test_top_p_nucleus_sampling():
+    from tpu_p2p.models import decode as D
+
+    cfg = _cfg(microbatches=1)
+    mesh = _mesh()
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh, cfg)
+    step = D.make_flagship_lm_decode_step(mesh, cfg)
+    toks, _ = F.flagship_token_batch(cfg, mesh)
+    prompt = toks[:, :4]
+
+    # A vanishing nucleus (top_p -> 0) keeps only the argmax token:
+    # the rollout must equal greedy for any temperature/key.
+    cache_a = D.init_kv_cache(cfg, max_len=16, mesh=mesh)
+    _, greedy = D.generate_tokens(step, params, cache_a, prompt,
+                                  num_tokens=6)
+    cache_b = D.init_kv_cache(cfg, max_len=16, mesh=mesh)
+    _, p_tiny = D.generate_tokens(step, params, cache_b, prompt,
+                                  num_tokens=6, temperature=5.0,
+                                  top_p=1e-9, rng=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(p_tiny), np.asarray(greedy))
+
+    # A wide nucleus at high temperature diverges from greedy and
+    # stays inside the vocab; composes with top_k.
+    cache_c = D.init_kv_cache(cfg, max_len=16, mesh=mesh)
+    _, hot = D.generate_tokens(step, params, cache_c, prompt,
+                               num_tokens=6, temperature=5.0,
+                               top_p=0.95, top_k=cfg.vocab,
+                               rng=jax.random.PRNGKey(1))
+    assert (np.asarray(hot)[:, 4:] < cfg.vocab).all()
+    assert (np.asarray(hot) != np.asarray(greedy)).any()
+
+    # Validation: out-of-range top_p; top_p without temperature.
+    with pytest.raises(ValueError, match="top_p"):
+        D.generate_tokens(step, params, cache_c, prompt, num_tokens=2,
+                          temperature=1.0, top_p=1.5,
+                          rng=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="no effect"):
+        D.generate_tokens(step, params, cache_c, prompt, num_tokens=2,
+                          top_p=0.9)

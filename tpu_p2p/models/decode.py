@@ -291,7 +291,7 @@ def make_flagship_lm_decode_step(mesh: Mesh, cfg: FlagshipConfig):
 
 def generate_tokens(step_fn, params, cache: Cache, prompt, *,
                     num_tokens: int, temperature: float = 0.0,
-                    top_k: int = 0,
+                    top_k: int = 0, top_p: float = 0.0,
                     rng: Optional[jax.Array] = None) -> Tuple[Cache, jax.Array]:
     """LM rollout: consume the prompt ``[B, T0]`` token by token
     (prefill scan), then sample ``num_tokens`` continuations
@@ -301,22 +301,28 @@ def generate_tokens(step_fn, params, cache: Cache, prompt, *,
     Sampling: ``temperature == 0`` (default) is greedy argmax;
     otherwise logits are divided by ``temperature`` and sampled
     categorically (``rng`` required), restricted to the ``top_k``
-    highest-probability tokens when ``top_k > 0``.
+    highest-probability tokens when ``top_k > 0`` and/or the nucleus
+    of tokens covering ``top_p`` probability mass when
+    ``0 < top_p < 1`` (top_k applies first, the standard composition;
+    the highest-probability token always stays in the support).
     """
     t0 = prompt.shape[1]
     if temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature > 0 and rng is None:
         raise ValueError("temperature sampling needs an rng key")
-    if temperature == 0 and (top_k > 0 or rng is not None):
-        # Mirror the check above: top_k/rng with greedy decoding means
-        # the caller forgot temperature= and would silently get argmax.
+    if temperature == 0 and (top_k > 0 or top_p > 0 or rng is not None):
+        # Mirror the check above: top_k/top_p/rng with greedy decoding
+        # means the caller forgot temperature= and would silently get
+        # argmax.
         raise ValueError(
-            "top_k/rng have no effect at temperature=0 (greedy); pass "
-            "temperature>0 to sample"
+            "top_k/top_p/rng have no effect at temperature=0 (greedy); "
+            "pass temperature>0 to sample"
         )
     if top_k < 0:
         raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
     max_len = cache["k"].shape[3]
     if t0 + num_tokens > max_len:
         # dynamic_update_slice clamps, so overflowing the window would
@@ -344,6 +350,19 @@ def generate_tokens(step_fn, params, cache: Cache, prompt, *,
             if top_k > 0:
                 kth = jax.lax.top_k(z, top_k)[0][:, -1:]
                 z = jnp.where(z >= kth, z, -jnp.inf)
+            if 0.0 < top_p < 1.0:
+                # Nucleus: keep the smallest prefix of the
+                # descending-probability order whose mass reaches
+                # top_p. A token survives iff the mass *before* it is
+                # still under top_p — so the argmax token always
+                # survives (its "before" mass is 0) and sampling can
+                # never land on an empty support.
+                z_sorted = jax.lax.top_k(z, z.shape[-1])[0]
+                probs = jax.nn.softmax(z_sorted, axis=-1)
+                before = jnp.cumsum(probs, axis=-1) - probs
+                kept = jnp.where(before < top_p, z_sorted, jnp.inf)
+                cutoff = jnp.min(kept, axis=-1, keepdims=True)
+                z = jnp.where(z >= cutoff, z, -jnp.inf)
             return jax.random.categorical(key, z, axis=-1).astype(
                 jnp.int32
             )[:, None]
