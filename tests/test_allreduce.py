@@ -113,3 +113,57 @@ def test_cli_runs_reduction_patterns():
                  "--iters", "2"]) == 0
     assert main(["--pattern", "reduce_scatter", "--msg-size", "2KiB",
                  "--iters", "2", "--mode", "differential"]) == 0
+
+
+# --------------------------------------------------------------- all_gather
+
+
+def test_all_gather_matches_host_oracle(rt):
+    from tpu_p2p.workloads.allreduce import run_all_gather  # noqa: F401
+
+    x = C.make_payload(rt.mesh, 512)  # 512 elems / 8 devices = 64 each
+    got = np.asarray(C.CollectiveCache().all_gather(rt.mesh, "d")(x))
+    want = C.expected_all_gather(np.asarray(x))
+    assert got.shape == want.shape  # slice-own-chunk + gather: preserved
+    np.testing.assert_array_equal(got, want)
+
+
+def test_ag_chain_is_idempotent_after_first_hop(rt):
+    # Hop 1 makes every row the diagonal concat; every later hop slices
+    # chunk j of that (== row j's original chunk) and regathers the
+    # same thing — so chain(3) == chain(1).
+    x = C.make_payload(rt.mesh, 512)
+    one = np.asarray(C.CollectiveCache().ag_chain(rt.mesh, "d", 1)(x))
+    three = np.asarray(C.CollectiveCache().ag_chain(rt.mesh, "d", 3)(x))
+    np.testing.assert_array_equal(one, three)
+    np.testing.assert_array_equal(one, C.expected_all_gather(np.asarray(x)))
+
+
+@pytest.mark.parametrize("mode", ["serialized", "fused", "differential"])
+def test_all_gather_workload_runs(rt, mode, capsys):
+    from tpu_p2p.workloads.allreduce import run_all_gather
+
+    # differential needs a long enough chain for a positive slope on a
+    # noisy CPU (same iters bump as the allreduce/RS mode tests above).
+    ctx = _ctx(rt, pattern="all_gather", msg_size=4096, mode=mode,
+               check=(mode == "serialized"),
+               iters=32 if mode == "differential" else 2)
+    (res,) = run_all_gather(ctx)
+    assert res["gbps_per_device"] > 0
+    out = capsys.readouterr().out
+    assert "all_gather" in out and "(n-1)/n" in out
+
+
+def test_all_gather_rejects_undividable_payload(rt):
+    from tpu_p2p.workloads.allreduce import run_all_gather
+
+    ctx = _ctx(rt, pattern="all_gather", msg_size=100)  # 100 % 8 != 0
+    with pytest.raises(BackendError, match="divisible"):
+        run_all_gather(ctx)
+
+
+def test_all_gather_registered_in_cli():
+    from tpu_p2p.config import PATTERNS
+    from tpu_p2p.workloads import WORKLOADS
+
+    assert "all_gather" in PATTERNS and "all_gather" in WORKLOADS

@@ -70,6 +70,7 @@ PATTERNS = (
     "latency",       # 8B p50 send/recv latency (BASELINE metric)
     "allreduce",     # psum busbw — the DP gradient transport
     "reduce_scatter",  # psum_scatter busbw — the ZeRO gradient transport
+    "all_gather",    # tiled all_gather busbw — the ZeRO parameter transport
     "ring_attention",  # flagship SP workload over the same transport
     "ulysses_attention",  # all_to_all SP counterpart (configs[3] transport)
     "flagship_step",  # the composite 5-axis train-step benchmark

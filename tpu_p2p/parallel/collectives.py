@@ -343,6 +343,69 @@ class CollectiveCache:
 
         return self._get(key, build)
 
+    def all_gather(self, mesh: Mesh, axis: str):
+        """One tiled ``all_gather`` of each device's own payload chunk —
+        the ZeRO *parameter* transport (tpu_p2p/parallel/fsdp.py
+        gather-on-use), the reverse of :meth:`reduce_scatter`.
+
+        Framing keeps shapes chain-able and accounting symmetric with
+        RS: the payload is the logical *gathered* buffer; each hop
+        slices the device's own 1/n chunk locally (no comm) and
+        gathers it back to full size — ``(n-1)/n * msg`` bytes per
+        device per op, the NCCL all-gather busbw convention."""
+        key = ("ag", mesh, axis)
+
+        def build():
+            spec = P(*mesh.axis_names, None)
+            n = mesh.shape[axis]
+
+            def f(x):
+                c = x.shape[-1] // n
+                own = jax.lax.dynamic_slice_in_dim(
+                    x, jax.lax.axis_index(axis) * c, c, x.ndim - 1
+                )
+                return jax.lax.all_gather(
+                    own, axis, axis=own.ndim - 1, tiled=True
+                )
+
+            return jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+            )
+
+        return self._get(key, build)
+
+    def ag_chain(self, mesh: Mesh, axis: str, count: int):
+        """``count`` data-dependent slice-own-chunk + ``all_gather``
+        hops in one program — the fused/differential unit of the
+        ``all_gather`` workload (the slice is a local copy; only the
+        gather moves bytes)."""
+        key = ("ag_chain", mesh, axis, count)
+
+        def build():
+            spec = P(*mesh.axis_names, None)
+            n = mesh.shape[axis]
+
+            def f(x):
+                c = x.shape[-1] // n
+                idx = jax.lax.axis_index(axis) * c
+
+                def step(carry, _):
+                    own = jax.lax.dynamic_slice_in_dim(
+                        carry, idx, c, carry.ndim - 1
+                    )
+                    return jax.lax.all_gather(
+                        own, axis, axis=own.ndim - 1, tiled=True
+                    ), None
+
+                out, _ = jax.lax.scan(step, x, None, length=count)
+                return out
+
+            return jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+            )
+
+        return self._get(key, build)
+
     def __len__(self) -> int:
         return len(self._cache)
 
@@ -366,6 +429,20 @@ def expected_reduce_scatter(x: np.ndarray) -> np.ndarray:
     n, elems = x.shape
     assert elems % n == 0
     return expected_all_reduce(x)[0].reshape(n, elems // n)
+
+
+def expected_all_gather(x: np.ndarray) -> np.ndarray:
+    """Host semantics of the slice-own-chunk + tiled all_gather over a
+    flat-mesh payload ``[n, elems]``: every row becomes the diagonal
+    concatenation — chunk ``j`` of the result is row ``j``'s own chunk
+    ``j``."""
+    if x.ndim != 2:
+        raise ValueError(f"expected a [devices, elems] payload, got {x.shape}")
+    n, elems = x.shape
+    assert elems % n == 0
+    c = elems // n
+    diag = np.concatenate([x[j, j * c:(j + 1) * c] for j in range(n)])
+    return np.broadcast_to(diag, x.shape).copy()
 
 
 def expected_all_to_all(x: np.ndarray, axis_size: int) -> np.ndarray:

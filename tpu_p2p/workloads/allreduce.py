@@ -1,11 +1,13 @@
-"""Reduction collectives — ``allreduce`` (psum) and ``reduce_scatter``.
+"""Reduction/gather collectives — ``allreduce`` (psum),
+``reduce_scatter``, and ``all_gather``.
 
 The reference measures only point-to-point transport
 (``/root/reference/p2p_matrix.cc:141-267``); these patterns complete
-the named-workload set with the *reduction* transports of SURVEY.md
-§2.3's DP row and the ZeRO/FSDP path (tpu_p2p/parallel/fsdp.py):
-data-parallel gradients ride allreduce, ZeRO gradients ride
-reduce-scatter (and the matching parameter gathers ride all-gather).
+the named-workload set with the *reduction and gather* transports of
+SURVEY.md §2.3's DP row and the ZeRO/FSDP path
+(tpu_p2p/parallel/fsdp.py): data-parallel gradients ride allreduce,
+ZeRO gradients ride reduce-scatter, and the matching parameter
+gathers ride all-gather.
 
 Byte accounting follows the standard ring-algorithm busbw convention
 so the numbers compare directly with NCCL's ``busbw`` column:
@@ -54,6 +56,19 @@ def _run_reduction(ctx: WorkloadContext, name: str) -> list:
             chain = lambda k: ctx.cache.psum_chain(mesh, "d", k)
             bpd = 2 * (n - 1) * msg_bytes // n
             note = "ring busbw 2(n-1)/n"
+        elif name == "all_gather":
+            if x.shape[-1] % n:
+                raise BackendError(
+                    f"all_gather needs payload elems divisible by "
+                    f"{n} devices; {format_size(msg_bytes)} of {cfg.dtype} "
+                    f"gives {x.shape[-1]}"
+                )
+            single = ctx.cache.all_gather(mesh, "d")
+            chain = lambda k: ctx.cache.ag_chain(mesh, "d", k)
+            # The payload is the gathered buffer; each op slices the
+            # own 1/n chunk locally and gathers — NCCL AG busbw.
+            bpd = (n - 1) * msg_bytes // n
+            note = "(n-1)/n"
         else:
             if x.shape[-1] % n:
                 raise BackendError(
@@ -72,9 +87,11 @@ def _run_reduction(ctx: WorkloadContext, name: str) -> list:
             ctx, single, chain, x, bytes_per_device=bpd
         )
         if cfg.check:
-            want = (C.expected_all_reduce(np.asarray(x))
-                    if name == "allreduce"
-                    else C.expected_reduce_scatter(np.asarray(x)))
+            want = {
+                "allreduce": C.expected_all_reduce,
+                "reduce_scatter": C.expected_reduce_scatter,
+                "all_gather": C.expected_all_gather,
+            }[name](np.asarray(x))
             _verify(single, x, want, f"{name} at {msg_bytes}B")
         if ctx.is_printer:
             sys.stdout.write(
@@ -102,3 +119,8 @@ def run_allreduce(ctx: WorkloadContext) -> list:
 @workload("reduce_scatter")
 def run_reduce_scatter(ctx: WorkloadContext) -> list:
     return _run_reduction(ctx, "reduce_scatter")
+
+
+@workload("all_gather")
+def run_all_gather(ctx: WorkloadContext) -> list:
+    return _run_reduction(ctx, "all_gather")
