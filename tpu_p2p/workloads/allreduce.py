@@ -51,18 +51,19 @@ def _run_reduction(ctx: WorkloadContext, name: str) -> list:
     results = []
     for msg_bytes in cfg.sizes():
         x = ctx.payloads.get(mesh, msg_bytes, np.dtype(cfg.dtype))
+        if name != "allreduce" and x.shape[-1] % n:
+            # Both tiled collectives split the payload dim n ways.
+            raise BackendError(
+                f"{name} needs payload elems divisible by "
+                f"{n} devices; {format_size(msg_bytes)} of {cfg.dtype} "
+                f"gives {x.shape[-1]}"
+            )
         if name == "allreduce":
             single = ctx.cache.all_reduce(mesh, "d")
             chain = lambda k: ctx.cache.psum_chain(mesh, "d", k)
             bpd = 2 * (n - 1) * msg_bytes // n
             note = "ring busbw 2(n-1)/n"
         elif name == "all_gather":
-            if x.shape[-1] % n:
-                raise BackendError(
-                    f"all_gather needs payload elems divisible by "
-                    f"{n} devices; {format_size(msg_bytes)} of {cfg.dtype} "
-                    f"gives {x.shape[-1]}"
-                )
             single = ctx.cache.all_gather(mesh, "d")
             chain = lambda k: ctx.cache.ag_chain(mesh, "d", k)
             # The payload is the gathered buffer; each op slices the
@@ -70,12 +71,6 @@ def _run_reduction(ctx: WorkloadContext, name: str) -> list:
             bpd = (n - 1) * msg_bytes // n
             note = "(n-1)/n"
         else:
-            if x.shape[-1] % n:
-                raise BackendError(
-                    f"reduce_scatter needs payload elems divisible by "
-                    f"{n} devices; {format_size(msg_bytes)} of {cfg.dtype} "
-                    f"gives {x.shape[-1]}"
-                )
             single = ctx.cache.reduce_scatter(mesh, "d")
             chain = lambda k: ctx.cache.rs_ag_chain(mesh, "d", k)
             # Serialized times the bare RS; chained modes time RS+AG.
