@@ -26,7 +26,17 @@ def test_entry_compiles_single_chip():
 def test_dryrun_multichip(n, capsys):
     mod = _load()
     mod.dryrun_multichip(n)
-    assert "dryrun_multichip OK" in capsys.readouterr().out
+    out = capsys.readouterr().out
+    assert "dryrun_multichip OK" in out
+    if n >= 2:
+        # Round-2 verdict next #3: the dryrun artifact must carry the
+        # reference workload itself — a verified pairwise matrix plus
+        # ring and all_to_all cells — not just the flagship model.
+        assert "dryrun benchmark OK" in out
+        assert "payloads verified" in out
+        assert "Uni-Directional TPU P2P Bandwidth" in out
+    else:
+        assert "dryrun benchmark skipped" in out
 
 
 def test_dryrun_bootstraps_when_devices_missing(monkeypatch, capfd):

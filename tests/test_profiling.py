@@ -129,6 +129,135 @@ def test_validate_differential_cpu_mesh_reports_unjudged(tmp_path, rt):
     assert "not judged" in v.describe()
 
 
+def test_measure_headline_cpu_falls_back_to_host(rt):
+    # No device track on the simulated CPU platform: the headline is
+    # the host slope and says so; validation is unjudged, not false.
+    from tpu_p2p.parallel import collectives as C
+
+    cache = C.CollectiveCache()
+    x = C.make_payload(rt.mesh, 4096)
+    edges = C.ring_edges(rt.num_devices)
+    axis = rt.mesh.axis_names[0]
+    # 64-op chains: an 8-op chain's sub-µs slope can flip nonpositive
+    # under CPU scheduler noise, turning the source into "none" flakily.
+    m = P.measure_headline(
+        lambda k: cache.permute_chain(rt.mesh, axis, edges, k), x, 64,
+    )
+    assert m.source == "host_differential"
+    assert m.device_per_op_s is None
+    assert m.per_op_s == m.host_per_op_s
+    assert m.ok is None
+    v = m.validation_fields()
+    assert v["ok"] is None and v["headline_source"] == "host_differential"
+
+
+def test_measure_headline_prefers_device_slope(rt, monkeypatch):
+    # When a device slope exists it IS the published number (round-2
+    # verdict #1), regardless of what the noisy host clock said.
+    from tpu_p2p.parallel import collectives as C
+
+    monkeypatch.setattr(
+        P, "differential_from_trace", lambda *a, **kw: 42e-6
+    )
+    cache = C.CollectiveCache()
+    x = C.make_payload(rt.mesh, 4096)
+    edges = C.ring_edges(rt.num_devices)
+    axis = rt.mesh.axis_names[0]
+    m = P.measure_headline(
+        lambda k: cache.permute_chain(rt.mesh, axis, edges, k), x, 8,
+    )
+    assert m.source == "device_trace"
+    assert m.per_op_s == pytest.approx(42e-6)
+    assert m.device_per_op_s == pytest.approx(42e-6)
+    assert m.validation_fields()["headline_source"] == "device_trace"
+
+
+def test_measure_headline_remeasures_on_disagreement():
+    # Host and device disagreeing beyond 1.3x triggers exactly one
+    # re-measure of BOTH slopes (interleaved in time), and the device
+    # slopes are averaged — the published number never comes from a
+    # single capture that its own diagnostic contradicts.
+    from tpu_p2p.utils.timing import Samples
+
+    device_slopes = iter([10e-6, 12e-6])
+    host_means = iter([100e-6, 11e-6])  # first: a bad relay period
+    captures = []
+
+    class FakeTiming:
+        @staticmethod
+        def measure_differential(make_chain, x, iters, repeats=3):
+            s = Samples()
+            mean = next(host_means)
+            s.iter_seconds = [mean] * repeats
+            s.region_seconds = mean * repeats
+            return s
+
+    def fake_from_trace(td, short, iters, runs=2):
+        captures.append(td)
+        return next(device_slopes)
+
+    import unittest.mock as mock
+
+    import jax.numpy as jnp
+    import jax
+
+    f = jax.jit(lambda x: x + 1)
+    with mock.patch.object(P, "differential_from_trace", fake_from_trace):
+        m = P.measure_headline(
+            lambda k: f, jnp.zeros((4,)), 8, timing=FakeTiming,
+        )
+    assert m.remeasured is True
+    assert len(captures) == 2
+    assert m.per_op_s == pytest.approx(11e-6)  # mean of the captures
+    assert m.source == "device_trace"
+    # The diagnostic host number is the fresher (second) measurement.
+    assert m.host_per_op_s == pytest.approx(11e-6)
+    assert m.ok is True
+
+
+def test_headline_degenerate_host_is_unjudged_not_failed():
+    # A noisy relay period can flip the host differential negative
+    # while the device slope is healthy and published; that must read
+    # as "diagnostic unavailable" (None), not a failed validation that
+    # appears to refute the published number.
+    m = P.HeadlineMeasurement(
+        per_op_s=1e-5, source="device_trace", host_per_op_s=-1e-7,
+        device_per_op_s=1e-5, ratio=None, tol=2.0, n_short=1, n_long=8,
+    )
+    assert m.ok is None
+    v = m.validation_fields()
+    assert v["ok"] is None
+    # The degenerate host number stays visible (honest diagnostic).
+    assert v["host_us_per_op"] == pytest.approx(-0.1)
+    # A degenerate DEVICE slope is still a hard failure.
+    bad = P.HeadlineMeasurement(
+        per_op_s=None, source="none", host_per_op_s=1e-5,
+        device_per_op_s=0.0, ratio=0.0, tol=2.0, n_short=1, n_long=8,
+    )
+    assert bad.ok is False
+
+
+def test_measure_headline_timeout_returns_none():
+    from tpu_p2p.utils.timing import Samples
+
+    class FakeTiming:
+        @staticmethod
+        def measure_differential(make_chain, x, iters, repeats=3):
+            s = Samples()
+            s.timed_out = True
+            return s
+
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    m = P.measure_headline(lambda k: f, jnp.zeros((4,)), 8,
+                           timing=FakeTiming)
+    assert m.per_op_s is None
+    assert m.source == "none"
+    assert m.timed_out is True
+
+
 def test_cli_validate_timing_flag(tmp_path, capsys):
     from tpu_p2p import cli
 
