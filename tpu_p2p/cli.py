@@ -192,8 +192,55 @@ def _validate_timing(rt, cfg) -> int:
         # tens of ms of real device time.
         v = validate_differential(chain_of, x, max(128, cfg.iters),
                                   trace_dir=td, timing=timing, repeats=5)
-    print(f"# {v.describe()}  [{label}, {msg} B]")
+    # Every rank validates (each has its own host clock and local
+    # trace), but only the printer rank reports — like all other
+    # stdout (advisor round-2 #4). The nonzero exit stays per-rank:
+    # any rank's MISMATCH fails its process, which the launcher sees.
+    import jax
+
+    if jax.process_index() == 0:
+        print(f"# {v.describe()}  [{label}, {msg} B]")
     return 0 if v.ok in (True, None) else 1
+
+
+def _assert_resume_agreement(done: dict) -> None:
+    """Fail fast when ranks disagree on the resumed done-cell set.
+
+    JSONL records are written by the printer rank only, so ``--resume``
+    on a multi-host run requires the log on a filesystem every rank
+    reads (workloads/base.py docstring). If ranks instead load
+    different sets — e.g. per-host local paths where non-zero ranks
+    see an empty file — each skips different cells and the job
+    deadlocks at a per-cell barrier. Comparing a digest of the set
+    across ranks turns that silent hang into an immediate, explained
+    error (advisor round-2 #3). Single-process: no-op.
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    import hashlib
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    digest = hashlib.sha256(
+        repr(sorted(map(repr, done))).encode()
+    ).digest()[:8]
+    mine = np.frombuffer(digest, dtype=np.uint8)
+    try:
+        multihost_utils.assert_equal(
+            mine,
+            "ranks disagree on the --resume done-cell set; put the "
+            "--jsonl log on a filesystem shared by every process",
+        )
+    except AssertionError:
+        raise
+    except Exception as e:  # pragma: no cover - backend-specific raise
+        raise RuntimeError(
+            "ranks disagree on the --resume done-cell set; put the "
+            "--jsonl log on a filesystem shared by every process"
+        ) from e
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -235,11 +282,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         from tpu_p2p.workloads.base import WorkloadContext
 
+        done = load_done_cells(cfg.jsonl) if cfg.resume else {}
+        if cfg.resume:
+            _assert_resume_agreement(done)
         ctx = WorkloadContext(
             rt=rt,
             cfg=cfg,
             jsonl=JsonlWriter(cfg.jsonl) if cfg.jsonl else None,
-            done=load_done_cells(cfg.jsonl) if cfg.resume else {},
+            done=done,
         )
         try:
             if cfg.profile_dir:

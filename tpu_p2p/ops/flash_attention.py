@@ -490,11 +490,20 @@ def _recompute_p(q, kblk, Lr, offs_ref, q_idx, k_idx, bq, bk, causal,
     ``masked=False``: the caller proved every (q, k) pair in the tile
     visible — skip the iota/compare/where VPU work entirely (the same
     interior-tile fast path as the forward kernel).
+
+    The scale is folded into q BEFORE the dot with the same
+    quantization as the forward (``(q * scale).astype(q.dtype)``,
+    :func:`_flash_call`) — post-scaling the f32 logits instead would
+    compute S by a different formula than the forward's, so the
+    rebuilt P would no longer exactly match the saved L on bf16
+    inputs (round-2 advisor #2). The caller's ``ds``/``dk``/``dq``
+    accumulations keep the un-folded q; only the recompute shares the
+    forward's rounding.
     """
     s = jax.lax.dot_general(
-        q, kblk, (((1,), (1,)), ((), ())),
+        (q * scale).astype(q.dtype), kblk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ) * scale                          # (bq, bk)
+    )                                  # (bq, bk); scale pre-folded
     if causal and masked:
         q_pos = offs_ref[0] + q_idx * bq + jax.lax.broadcasted_iota(
             jnp.int32, (bq, 1), 0

@@ -71,6 +71,35 @@ def payload_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(*mesh.axis_names, None))
 
 
+def host_payload(mesh: Mesh, msg_bytes: int, dtype=jnp.int8) -> np.ndarray:
+    """The host-side oracle for :func:`make_payload`'s device value.
+
+    Deterministic from (mesh shape, size, dtype), so every process in a
+    multi-host job reconstructs the identical global value without any
+    device→host gather — the basis for shard-local verification
+    (:func:`verify_against`) where ``np.asarray`` on a non-addressable
+    global array would throw.
+    """
+    return _payload_np(mesh.devices.shape, elems_for(msg_bytes, dtype), dtype)
+
+
+def verify_against(got, want: np.ndarray) -> bool:
+    """Compare a device array against a host oracle, multi-process-safe.
+
+    Single-process (fully addressable): whole-array comparison. Multi-
+    process: each process checks only its addressable shards against
+    the corresponding slices of the oracle — together the job covers
+    every element, and no host ever materializes the global array
+    (the same discipline as ``DeviceLoader``'s shard assembly).
+    """
+    if getattr(got, "is_fully_addressable", True):
+        return bool(np.array_equal(np.asarray(got), want))
+    return all(
+        np.array_equal(np.asarray(sh.data), want[sh.index])
+        for sh in got.addressable_shards
+    )
+
+
 def make_payload(mesh: Mesh, msg_bytes: int, dtype=jnp.int8) -> jax.Array:
     """Device-resident send buffer, one row per mesh device.
 
