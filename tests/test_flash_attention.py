@@ -238,3 +238,28 @@ def test_flash_gqa_rejects_non_divisible_heads():
     k, v = _qkv(b=2, h=3, t=32, d=16, seed=2)[1:]
     with pytest.raises(ValueError, match="multiple of KV heads"):
         F.flash_attention(q, k, v, True)
+
+
+def test_causal_cell_tables():
+    """The flat-grid live-cell tables (one builder, both major orders):
+    full/liveness boundary arithmetic and the seed flags, including the
+    seed-only dead cell for k tiles wholly beyond the q range (their
+    dk/dv output blocks must still be zero-seeded, never skipped)."""
+    # q-major, 2x2 tiles of 64: row j=1 sees both k tiles; the
+    # diagonal tiles are masked (full=0), interior tile full.
+    tab = F._causal_cells(2, 2, 64, 64, major="q")
+    assert tab.tolist() == [
+        [0, 1, 1],      # q tile
+        [0, 0, 1],      # k tile
+        [0, 1, 0],      # full?
+        [1, 1, 0],      # first-of-q-tile?
+    ]
+    # k-major with tk > tq (n_q=1, n_k=2): k tile 1 has no live q
+    # tile and gets exactly one masked seed cell (contributes 0).
+    tab = F._causal_cells(1, 2, 64, 64, major="k")
+    assert tab.tolist() == [
+        [0, 1],
+        [0, 0],
+        [0, 0],
+        [1, 1],
+    ]
