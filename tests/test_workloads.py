@@ -256,3 +256,57 @@ def test_isolation_modes_agree_on_verification(rt, tmp_path):
     # Identical measured cell keys from both modes — derived from what
     # each mode actually recorded, not from config echoes.
     assert keys["full"] == keys["submesh"] and len(keys["full"]) == 12
+
+
+def test_device_mode_ring_falls_back_to_host_on_cpu(rt, tmp_path, capsys):
+    """--mode device: the cell value is the device-timeline slope; on
+    the CPU test mesh (no device track) it falls back to the host slope
+    and the cell record says which source it published."""
+    path = str(tmp_path / "cells.jsonl")
+    ctx = WorkloadContext(
+        rt=rt,
+        cfg=BenchConfig(pattern="ring", msg_size=4096, iters=16,
+                        mode="device"),
+        jsonl=JsonlWriter(path),
+    )
+    out = run_ring(ctx)
+    ctx.jsonl.close()
+    assert out[0]["gbps_per_device"] > 0
+    assert "ring" in capsys.readouterr().out
+    rec = json.loads(open(path).read().splitlines()[0])
+    assert rec["mode"] == "device"
+    # CellRecord.to_json flattens extra into the top level.
+    assert rec["source"] == "host_differential"
+
+
+def test_device_mode_publishes_device_slope(rt, monkeypatch):
+    """When a device track exists, the cell value IS the device slope
+    (stubbed here — the CPU platform records none)."""
+    from tpu_p2p.utils.profiling import HeadlineMeasurement
+    import tpu_p2p.utils.profiling as P
+
+    msg = 4096
+
+    def fake_headline(make_chain, x, iters, **kw):
+        return HeadlineMeasurement(
+            per_op_s=1e-4, source="device_trace", host_per_op_s=3e-4,
+            device_per_op_s=1e-4, ratio=1 / 3, tol=2.0, n_short=2,
+            n_long=16,
+        )
+
+    monkeypatch.setattr(P, "measure_headline", fake_headline)
+    ctx = WorkloadContext(
+        rt=rt,
+        cfg=BenchConfig(pattern="ring", msg_size=msg, iters=16,
+                        mode="device"),
+    )
+    out = run_ring(ctx)
+    # 4096 B * 8 / 1e-4 s / 1e9 = 0.32768 Gbps per device
+    assert out[0]["gbps_per_device"] == pytest.approx(0.32768, rel=1e-6)
+
+
+def test_device_mode_in_config_choices():
+    cfg = BenchConfig(mode="device")
+    assert cfg.mode == "device"
+    with pytest.raises(ValueError):
+        BenchConfig(mode="nonsense")

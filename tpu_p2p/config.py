@@ -76,9 +76,15 @@ PATTERNS = (
     "flagship_step",  # the composite 5-axis train-step benchmark
 )
 
-MODES = ("serialized", "fused", "differential")  # SURVEY.md §7 hard part (c);
+MODES = ("serialized", "fused", "differential", "device")
+# SURVEY.md §7 hard part (c):
 # differential = two-chain-length slope, cancels all constant per-call
-# overhead (the only trustworthy mode on relayed PJRT platforms)
+# overhead (the only trustworthy HOST mode on relayed PJRT platforms);
+# device = the differential slope read off XLA's own device timeline
+# (jax.profiler trace — the cudaEvent_t analogue, BASELINE.json north
+# star), immune to host/relay jitter entirely; falls back to the host
+# slope on platforms recording no device track (CPU), and each cell
+# records which source it published.
 ISOLATIONS = ("full", "submesh")  # SURVEY.md §7 hard part (a)
 DIRECTIONS = ("uni", "bi", "both")
 

@@ -346,6 +346,8 @@ def measure_headline(
     retol: float = 1.3,
     tol: float = 2.0,
     timing=None,
+    timeout_s=None,
+    barrier=None,
 ) -> HeadlineMeasurement:
     """Differential measurement publishing the device-trace slope.
 
@@ -382,7 +384,8 @@ def measure_headline(
 
     def host_slope():
         return timing.measure_differential(
-            lambda k: pre[k], x, iters, repeats=repeats
+            lambda k: pre[k], x, iters, repeats=repeats,
+            timeout_s=timeout_s, barrier=barrier,
         )
 
     def device_slope():

@@ -147,6 +147,23 @@ def measure_collective(
             chain_builder(cfg.iters), x, cfg.iters, repeats=cfg.fused_repeats,
             warmup=cfg.warmup, timeout_s=cfg.timeout_s, barrier=barrier,
         )
+    elif cfg.mode == "device":
+        # Device-timeline slope (the cudaEvent_t analogue) as the cell
+        # value — immune to host/relay jitter; host-slope fallback on
+        # platforms with no device track. The chosen source rides the
+        # Samples so cell records can publish it.
+        from tpu_p2p.utils.profiling import measure_headline
+
+        m = measure_headline(
+            chain_builder, x, cfg.iters, repeats=cfg.fused_repeats,
+            timing=timing, timeout_s=cfg.timeout_s, barrier=barrier,
+        )
+        s = timing.Samples()
+        s.timed_out = m.timed_out
+        if m.per_op_s is not None:
+            s.iter_seconds = [m.per_op_s]
+            s.region_seconds = m.per_op_s
+        s.source = m.source  # noqa: attr — carried for cell records
     else:  # differential
         s = timing.measure_differential(
             chain_builder, x, cfg.iters, repeats=cfg.fused_repeats,
@@ -195,6 +212,10 @@ def cell_record(
     hops = None
     if ctx.rt.torus is not None and src < ctx.rt.num_devices and dst < ctx.rt.num_devices:
         hops = ctx.rt.torus.hops(src, dst)
+    # Device mode stamps which timeline the value came from.
+    source = getattr(samples, "source", None)
+    if source is not None:
+        extra = {**extra, "source": source}
     return CellRecord(
         workload=workload,
         direction=direction,
