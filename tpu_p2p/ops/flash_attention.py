@@ -259,9 +259,14 @@ def _kernel_flat(tab_ref, q_ref, k_ref, v_ref, o0_ref, m0_ref, l0_ref,
         ex = jnp.exp2 if base2 else jnp.exp
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         alpha = ex(m - m_new)
+        # No explicit zeroing of masked lanes (unlike _kernel): in the
+        # zero-offset causal table every row's FIRST cell (kb == 0)
+        # has a visible key at k == 0, so m_new is finite for every
+        # row from its first accumulate on — exp(NEG_INF − finite)
+        # underflows to exactly 0. The rect kernel cannot assume this
+        # (live tiles there can hold fully-masked rows whose m is
+        # still the −∞ seed, where exp(s − m_new) would be exp(0)=1).
         p = ex(s - m_new)
-        if masked:
-            p = jnp.where(visible, p, 0.0)
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype), vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
