@@ -132,6 +132,14 @@ def _canon_edges(edges: Sequence[Edge], axis_size: int) -> Tuple[Edge, ...]:
     dsts = [d for _, d in canon]
     if len(set(dsts)) != len(dsts):
         raise ValueError(f"duplicate destination in edge set {canon}")
+    # XLA CollectivePermute (and jax.lax.ppermute) requires unique
+    # SOURCES as well — no multicast. Reject here with a clear error
+    # instead of surfacing jax's mid-lowering failure; this also
+    # matches the reference's semantics (one in-flight message per
+    # rank, p2p_matrix.cc:156-171).
+    srcs = [s for s, _ in canon]
+    if len(set(srcs)) != len(srcs):
+        raise ValueError(f"duplicate source in edge set {canon}")
     for s, d in canon:
         if not (0 <= s < axis_size and 0 <= d < axis_size):
             raise ValueError(
