@@ -76,6 +76,25 @@ def test_differential_from_trace_slope(tmp_path):
     assert slope == pytest.approx(10e-6, rel=1e-6)
 
 
+def test_differential_from_trace_multi_device_tracks(tmp_path):
+    # A multi-chip trace records each chain program once per device
+    # track (runs * n_devices occurrences in total); the slope must
+    # come from ONE device's track or the occurrence-count grouping
+    # matches nothing and the device slope silently vanishes —
+    # exactly on the first real multi-chip run.
+    events = [_meta(3, "/device:TPU:0"), _meta(4, "/device:TPU:1")]
+    t = 0.0
+    for dur_s, dur_l in ((30.0, 110.0), (32.0, 112.0)):
+        for name, dur in (("jit_f(111)", dur_s), ("jit_f(222)", dur_l)):
+            for pid in (3, 4):  # every device runs the program
+                events.append(_ev(pid, 2, name, t, dur))
+            t += 1000
+    slope = P.differential_from_trace(
+        _write_trace(tmp_path, events), 2, 10, runs=2
+    )
+    assert slope == pytest.approx(10e-6, rel=1e-6)
+
+
 def test_differential_from_trace_requires_enough_events(tmp_path):
     events = [_meta(3, "/device:TPU:0"), _ev(3, 1, "jit_chain", 0.0, 10.0)]
     with pytest.raises(ValueError, match="program groups"):
@@ -185,7 +204,7 @@ def test_measure_headline_remeasures_on_disagreement():
 
     class FakeTiming:
         @staticmethod
-        def measure_differential(make_chain, x, iters, repeats=3):
+        def measure_differential(make_chain, x, iters, repeats=3, **kw):
             s = Samples()
             mean = next(host_means)
             s.iter_seconds = [mean] * repeats
@@ -242,7 +261,7 @@ def test_measure_headline_timeout_returns_none():
 
     class FakeTiming:
         @staticmethod
-        def measure_differential(make_chain, x, iters, repeats=3):
+        def measure_differential(make_chain, x, iters, repeats=3, **kw):
             s = Samples()
             s.timed_out = True
             return s

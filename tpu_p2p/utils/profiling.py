@@ -145,11 +145,21 @@ def differential_from_trace(trace_dir: str, n_short: int, n_long: int,
     keep the groups seen exactly ``runs`` times; the longer-mean group
     is the longer chain. This is robust to launch-order interleaving
     and to whatever the fence lowers to.
+
+    Multi-device traces record every program once per device track;
+    counting across all tracks would see ``runs * n_devices``
+    occurrences and match nothing. Only the lowest device pid's events
+    are counted — any single device's program duration spans the whole
+    (synchronized) collective, and the occurrence arithmetic then
+    matches the single-chip case exactly.
     """
     if is_program is None:
         is_program = lambda name: name.startswith("jit")  # noqa: E731
     tops = [t for t in device_top_level_events(trace_dir)
             if is_program(t.name)]
+    if tops:
+        pid0 = min(t.pid for t in tops)
+        tops = [t for t in tops if t.pid == pid0]
     groups: dict = {}
     for t in tops:
         groups.setdefault(t.name, []).append(t.dur)
