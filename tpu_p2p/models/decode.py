@@ -194,11 +194,23 @@ def _decode_stack(params, cache: Cache, x, pos, cfg, tp, ep):
             from tpu_p2p.ops.rope import apply_rope
 
             k_t = apply_rope(k_t, jnp.reshape(pos, (1,)))
-        k_st = jax.lax.dynamic_update_slice_in_dim(k_all[s], k_t, pos, axis=2)
-        v_st = jax.lax.dynamic_update_slice_in_dim(v_all[s], v_t, pos, axis=2)
-        k_all = jax.lax.dynamic_update_index_in_dim(k_all, k_st, s, 0)
-        v_all = jax.lax.dynamic_update_index_in_dim(v_all, v_st, s, 0)
-        x = _decode_sub_block(sub, x, h, k_st, v_st, pos, cfg, tp, ep)
+        # One DUS of the (1, B, H, 1, D) slab straight into the full
+        # cache, stage index static. The previous two-step form
+        # (slice stage -> update -> write stage back) materialized a
+        # read-modify-write of the whole 4 MB stage per K and per V —
+        # ~32 MB of HBM traffic per token, measured as 59% of the
+        # decode step on the v5e device timeline. A single small DUS
+        # into the scan carry aliases in place; the stage slice for
+        # the attention read is taken AFTER the update (static index,
+        # fused into the banded window read).
+        k_all = jax.lax.dynamic_update_slice(
+            k_all, k_t[None].astype(k_all.dtype), (s, 0, 0, pos, 0)
+        )
+        v_all = jax.lax.dynamic_update_slice(
+            v_all, v_t[None].astype(v_all.dtype), (s, 0, 0, pos, 0)
+        )
+        x = _decode_sub_block(sub, x, h, k_all[s], v_all[s], pos, cfg,
+                              tp, ep)
     return {"k": k_all, "v": v_all}, x
 
 
