@@ -310,3 +310,22 @@ def test_device_mode_in_config_choices():
     assert cfg.mode == "device"
     with pytest.raises(ValueError):
         BenchConfig(mode="nonsense")
+
+
+def test_device_mode_loopback_records_source(rt, tmp_path):
+    """Latency-family cells must also stamp which timeline their
+    per-hop estimate came from under --mode device (the serialized
+    p50 keeps its dispatch-inclusive meaning in every mode)."""
+    path = str(tmp_path / "cells.jsonl")
+    ctx = WorkloadContext(
+        rt=rt,
+        cfg=BenchConfig(pattern="loopback", msg_size=8192, iters=8,
+                        mode="device"),
+        jsonl=JsonlWriter(path),
+    )
+    run_loopback(ctx)
+    ctx.jsonl.close()
+    rec = json.loads(open(path).read().splitlines()[0])
+    assert rec["mode"] == "device"
+    assert rec["source"] == "host_differential"  # CPU: no device track
+    assert rec["fused_hop_s"] > 0

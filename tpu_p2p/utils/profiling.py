@@ -326,6 +326,23 @@ class HeadlineMeasurement:
             return None
         return (1.0 / self.tol) <= self.ratio <= self.tol
 
+    def as_samples(self):
+        """Adapter to the :class:`tpu_p2p.utils.timing.Samples` shape
+        the workload plumbing consumes (``--mode device``): one sample
+        holding the published per-op time, with the chosen ``source``
+        riding along for cell records. Kept here so the two device-mode
+        call sites (measure_collective, the latency per-hop estimate)
+        cannot drift."""
+        from tpu_p2p.utils import timing
+
+        s = timing.Samples()
+        s.timed_out = self.timed_out
+        if self.per_op_s is not None:
+            s.iter_seconds = [self.per_op_s]
+            s.region_seconds = self.per_op_s
+        s.source = self.source  # dynamic attr, read by cell_record
+        return s
+
     def validation_fields(self) -> dict:
         """JSON-ready ``timing_validation`` dict — derived from the
         same run as the headline, so the artifact cannot refute its
@@ -399,7 +416,12 @@ def measure_headline(
         )
 
     def device_slope():
-        fence = timing_mod.readback_fence
+        # Same watchdog contract as the host half: a wedged link must
+        # raise TransferTimeout here too, or --timeout would guard only
+        # half of a device-mode measurement.
+        def fence(v):
+            timing_mod.run_fenced(v, timeout_s)
+
         with tempfile.TemporaryDirectory(prefix="headline_") as td:
             with jax.profiler.trace(td):
                 for _ in range(runs):
@@ -424,8 +446,18 @@ def measure_headline(
             device_per_op_s=None, ratio=None, tol=tol, n_short=short,
             n_long=iters, timed_out=True, host_samples=s,
         )
+    from tpu_p2p.utils.errors import TransferTimeout
+
     host = s.mean_region
-    dev, note = device_slope()
+    try:
+        dev, note = device_slope()
+    except TransferTimeout:
+        # Wedged mid-capture: the whole measurement is a marked cell.
+        return HeadlineMeasurement(
+            per_op_s=None, source="none", host_per_op_s=host,
+            device_per_op_s=None, ratio=None, tol=tol, n_short=short,
+            n_long=iters, timed_out=True, host_samples=s,
+        )
     remeasured = False
     if dev is not None and host > 0 and not (
         (1.0 / retol) <= dev / host <= retol
@@ -435,7 +467,10 @@ def measure_headline(
         # (device time is stable — two captures bound the truth) and
         # take the fresher host number for the diagnostic.
         s2 = host_slope()
-        dev2, note2 = device_slope()
+        try:
+            dev2, note2 = device_slope()
+        except TransferTimeout:
+            dev2, note2 = None, "re-measure capture timed out"
         remeasured = True
         if dev2 is not None:
             dev = (dev + dev2) / 2.0

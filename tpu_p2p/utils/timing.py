@@ -263,6 +263,34 @@ def block_fence_is_trustworthy(refresh: bool = False) -> bool:
     return _fence_trust
 
 
+def run_fenced(value, timeout_s: Optional[float] = None,
+               fence: Callable = readback_fence) -> None:
+    """``fence(value)`` under the watchdog contract: with a timeout, a
+    wedged transfer raises :class:`TransferTimeout` instead of hanging
+    (shared by the host differential and the device-trace capture —
+    every timed execution path honors ``--timeout`` identically)."""
+    if timeout_s is None:
+        fence(value)
+        return
+    done = threading.Event()
+    err: list = []
+
+    def waiter():
+        try:
+            fence(value)
+        except Exception as e:  # pragma: no cover - device failure
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        raise TransferTimeout(f"transfer exceeded {timeout_s}s watchdog")
+    if err:
+        raise err[0]
+
+
 def measure_differential(
     make_chain: Callable[[int], Callable],
     x,
@@ -293,26 +321,7 @@ def measure_differential(
     def fenced(value):
         # Same watchdog contract as _block: a wedged link becomes a
         # marked cell, not a hung sweep.
-        if timeout_s is None:
-            fence(value)
-            return
-        done = threading.Event()
-        err: list = []
-
-        def waiter():
-            try:
-                fence(value)
-            except Exception as e:  # pragma: no cover - device failure
-                err.append(e)
-            finally:
-                done.set()
-
-        t = threading.Thread(target=waiter, daemon=True)
-        t.start()
-        if not done.wait(timeout_s):
-            raise TransferTimeout(f"transfer exceeded {timeout_s}s watchdog")
-        if err:
-            raise err[0]
+        run_fenced(value, timeout_s, fence)
 
     s = Samples()
     try:

@@ -154,16 +154,10 @@ def measure_collective(
         # Samples so cell records can publish it.
         from tpu_p2p.utils.profiling import measure_headline
 
-        m = measure_headline(
+        s = measure_headline(
             chain_builder, x, cfg.iters, repeats=cfg.fused_repeats,
             timing=timing, timeout_s=cfg.timeout_s, barrier=barrier,
-        )
-        s = timing.Samples()
-        s.timed_out = m.timed_out
-        if m.per_op_s is not None:
-            s.iter_seconds = [m.per_op_s]
-            s.region_seconds = m.per_op_s
-        s.source = m.source  # noqa: attr — carried for cell records
+        ).as_samples()
     else:  # differential
         s = timing.measure_differential(
             chain_builder, x, cfg.iters, repeats=cfg.fused_repeats,

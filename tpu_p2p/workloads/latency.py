@@ -62,16 +62,10 @@ def _measure_pair_latency(ctx: WorkloadContext, src: int, dst: int, nbytes: int)
             chain_of = lambda k: ctx.cache.permute_chain(  # noqa: E731
                 mesh, axis, edges, k
             )
-        m = measure_headline(
+        fused = measure_headline(
             chain_of, x, cfg.iters, repeats=cfg.fused_repeats,
             timing=timing, timeout_s=cfg.timeout_s, barrier=rt.barrier,
-        )
-        fused = timing.Samples()
-        fused.timed_out = m.timed_out
-        if m.per_op_s is not None:
-            fused.iter_seconds = [m.per_op_s]
-            fused.region_seconds = m.per_op_s
-        fused.source = m.source
+        ).as_samples()
         return ser, fused
     fused = timing.measure_fused(
         chain, x, cfg.iters, repeats=cfg.fused_repeats,
@@ -101,6 +95,10 @@ def run_latency(ctx: WorkloadContext) -> dict:
             ctx, workload="latency", direction="uni", src=src, dst=dst,
             msg_bytes=nbytes, gbps_val=timing.gbps(nbytes, ser.mean_region),
             samples=ser, fused_hop_s=fused.mean,
+            # Device mode: say which timeline fused_hop_s came from
+            # (ser keeps its dispatch-inclusive meaning in every mode).
+            **({"source": fused.source} if hasattr(fused, "source")
+               else {}),
         )
     )
     return {
@@ -139,6 +137,8 @@ def run_loopback(ctx: WorkloadContext) -> dict:
             ctx, workload="loopback", direction="uni", src=src, dst=dst,
             msg_bytes=nbytes, gbps_val=bw, samples=ser,
             fused_hop_s=fused.mean,
+            **({"source": fused.source} if hasattr(fused, "source")
+               else {}),
         )
     )
     return {"src": src, "dst": dst, "bytes": nbytes, "gbps": bw,
