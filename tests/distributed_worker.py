@@ -65,6 +65,12 @@ def main() -> None:
          "--msg-size", "8KiB", "--iters", "2", "--jsonl", jsonl],
         ["--pattern", "ring", "--check", "--msg-size", "8KiB",
          "--iters", "2", "--jsonl", jsonl],
+        # --mode device across two real processes: measure_headline's
+        # barrier forwarding (sync_global_devices inside the timed
+        # differential) and per-process trace capture execute live;
+        # on CPU the cell publishes the host-slope fallback.
+        ["--pattern", "ring", "--check", "--msg-size", "8KiB",
+         "--iters", "8", "--mode", "device", "--jsonl", jsonl],
     ):
         rc = cli_main(argv)
         assert rc == 0, f"{argv} -> rc {rc}"

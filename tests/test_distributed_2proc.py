@@ -95,8 +95,13 @@ def test_two_process_distributed_run(tmp_path):
     recs = [json.loads(ln) for ln in open(jsonl).read().splitlines()]
     pair_recs = [r for r in recs if r["workload"] == "pairwise"]
     ring_recs = [r for r in recs if r["workload"] == "ring"]
-    assert len(ring_recs) == 1
+    assert len(ring_recs) == 2  # differential-default + device mode
     keys = [(r["direction"], r["src"], r["dst"]) for r in pair_recs]
     assert len(keys) == len(set(keys)) == 24  # 12 uni + 12 bi, no dups
     # Cross-process cells are present (src and dst on different ranks).
     assert ("uni", 0, 3) in keys and ("uni", 3, 0) in keys
+    # The device-mode ring cell ran cross-process and stamped its
+    # source (CPU workers record no device track -> host fallback).
+    dev_ring = [r for r in ring_recs if r["mode"] == "device"]
+    assert len(dev_ring) == 1
+    assert dev_ring[0]["source"] == "host_differential"
