@@ -263,3 +263,55 @@ def test_causal_cell_tables():
         [0, 0],
         [1, 1],
     ]
+
+
+def test_causal_cell_tables_property_vs_bruteforce():
+    """Random tile geometries: both major orders of _causal_cells must
+    enumerate exactly the live (q, k) tile pairs (plus k-major's
+    seed-only dead cells), with full flags matching the brute-force
+    definition and seed flags marking each major tile's first cell —
+    the invariants the three flat kernels rely on for correctness."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    for _ in range(25):
+        bq = int(rng.choice([64, 128, 256, 512]))
+        bk = int(rng.choice([64, 128, 256, 512]))
+        n_q = int(rng.integers(1, 9))
+        n_k = int(rng.integers(1, 9))
+
+        def live(j, kb):
+            return kb * bk <= (j + 1) * bq - 1
+
+        def full(j, kb):
+            return (kb + 1) * bk - 1 <= j * bq
+
+        want_live = {(j, kb) for j in range(n_q) for kb in range(n_k)
+                     if live(j, kb)}
+
+        tab = F._causal_cells(n_q, n_k, bq, bk, major="q")
+        cells = list(zip(*tab.tolist()))
+        got = {(j, kb) for j, kb, _, _ in cells}
+        assert got == want_live, (bq, bk, n_q, n_k)
+        assert [c[0] for c in cells] == sorted(c[0] for c in cells)
+        for j, kb, f_, first in cells:
+            assert f_ == int(full(j, kb))
+            assert first == int(kb == min(k for q, k in want_live
+                                          if q == j))
+
+        tab = F._causal_cells(n_q, n_k, bq, bk, major="k")
+        cells = list(zip(*tab.tolist()))
+        livec = [(kb, qt) for kb, qt, _, _ in cells
+                 if (qt, kb) in want_live]
+        assert {(q, k) for k, q in livec} == want_live
+        assert [c[0] for c in cells] == sorted(c[0] for c in cells)
+        for kb, qt, f_, first in cells:
+            if (qt, kb) in want_live:
+                assert f_ == int(full(qt, kb))
+            else:
+                # Seed-only dead cell for a k tile beyond the q range:
+                # masked (contributes 0) and flagged first (seeds).
+                assert f_ == 0 and first == 1
+        # Every k tile is seeded exactly once (dk/dv zeroing).
+        seeds = [kb for kb, _, _, first in cells if first]
+        assert sorted(seeds) == list(range(n_k))
