@@ -57,7 +57,9 @@ HBM_PEAKS_GBYTES_PER_S = (
     ("v6 lite", "v6e_hbm_peak", 1638.0),
     ("v6e", "v6e_hbm_peak", 1638.0),
     ("v5p", "v5p_hbm_peak", 2765.0),
-    ("v5", "v5p_hbm_peak", 2765.0),  # after the lite spellings
+    # No bare-"v5" catch-all: an unmatched v5-family spelling must
+    # resolve to (None, None) — a null ratio beats a wrong-generation
+    # peak (advisor r3 #2).
     ("v4", "v4_hbm_peak", 1228.0),
     ("v3", "v3_hbm_peak", 900.0),
     ("v2", "v2_hbm_peak", 700.0),
@@ -200,8 +202,11 @@ def _flagship_step_metrics(timing):
         batch=8, seq=1024, heads=8, head_dim=64, stages=2, microbatches=1,
         num_experts=4, dtype="bfloat16", use_flash=True,
         # use_flash: at sp size 1 the trainable Pallas kernel runs
-        # directly — measured 1.9 ms/step vs ~4.7 dense (the dense path
-        # materializes the [B,H,T,T] scores; 256 MB at this shape).
+        # directly — device-timed 5.96 ms/step vs 11.5 dense
+        # (BENCH_r03 / BASELINE.md artifact column; earlier 1.9/4.7
+        # figures were relay-session noise, retracted BASELINE.md:55).
+        # The dense path materializes the [B,H,T,T] scores — 256 MB
+        # at this shape — which is where the 2x goes.
     )
 
     params0 = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
@@ -675,7 +680,12 @@ def main() -> int:
                 "cell_sources": cell_sources,
                 "bandwidth_vs_size": sweep,
                 **lat,
-                "mode": "differential",
+                # Structurally a differential measurement; "device"
+                # when the published slope came off the device
+                # timeline (advisor r3 #3: the field must not
+                # contradict headline_source).
+                "mode": ("device" if source == "device_trace"
+                         else "differential"),
                 "block_fence_trustworthy": fence_ok,
                 "timing_validation": validation,
                 "baseline_anchor": {
@@ -787,7 +797,8 @@ def main() -> int:
                 **flash_bwd,
                 **flagship,
                 **decode,
-                "mode": "differential",
+                "mode": ("device" if m.source == "device_trace"
+                         else "differential"),
                 "block_fence_trustworthy": fence_ok,
                 # Derived from the SAME measurement as the headline:
                 # the artifact cannot publish a value its own

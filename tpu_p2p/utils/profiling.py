@@ -390,7 +390,12 @@ def measure_headline(
     4. If both slopes exist and disagree beyond ``retol`` (1.3x), the
        whole measurement re-runs once — interleaved in time, so a
        transient relay stall cannot freeze a bad host number into the
-       diagnostic — and the device slopes are averaged.
+       diagnostic. Mutually consistent device captures are averaged;
+       otherwise the capture whose own host pair agrees wins (a
+       corrupted capture must not bleed into the published number).
+       Multi-process runs broadcast rank 0's re-measure decision so
+       every rank takes the same branch (the chains are global
+       collectives; a split decision would deadlock).
 
     The published ``per_op_s`` is the device slope when a device track
     exists (TPU), else the host slope (the simulated CPU mesh records
@@ -439,33 +444,70 @@ def measure_headline(
             except Exception as e:  # pragma: no cover - defensive
                 return None, f"trace capture failed: {e!r}"
 
+    def any_rank(flag: bool) -> bool:
+        # Every early-exit-vs-continue fork below must be taken by ALL
+        # ranks or none: the chains (and the broadcast further down)
+        # are global collectives, so a rank departing alone strands
+        # the rest. Timeouts cascade — a rank abandoning a chain wedges
+        # the others in it until their own watchdogs fire — so every
+        # rank does reach this sync point; the allgather then makes
+        # the *decision* uniform (any rank wedged → everyone returns
+        # the marked cell).
+        if jax.process_count() <= 1:
+            return flag
+        import numpy as _np
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(_np.asarray(flag))
+        return bool(_np.any(flags))
+
     s = host_slope()
-    if s.timed_out:
+    if any_rank(s.timed_out):
         return HeadlineMeasurement(
-            per_op_s=None, source="none", host_per_op_s=float("nan"),
+            per_op_s=None, source="none",
+            host_per_op_s=s.mean_region,  # nan when timed_out, by policy
             device_per_op_s=None, ratio=None, tol=tol, n_short=short,
             n_long=iters, timed_out=True, host_samples=s,
         )
     from tpu_p2p.utils.errors import TransferTimeout
 
     host = s.mean_region
+    dev_timed_out = False
     try:
         dev, note = device_slope()
     except TransferTimeout:
         # Wedged mid-capture: the whole measurement is a marked cell.
+        dev, note, dev_timed_out = None, None, True
+    if any_rank(dev_timed_out):
         return HeadlineMeasurement(
             per_op_s=None, source="none", host_per_op_s=host,
             device_per_op_s=None, ratio=None, tol=tol, n_short=short,
             n_long=iters, timed_out=True, host_samples=s,
         )
     remeasured = False
-    if dev is not None and host > 0 and not (
-        (1.0 / retol) <= dev / host <= retol
-    ):
+    want_remeasure = bool(
+        dev is not None and host > 0
+        and not ((1.0 / retol) <= dev / host <= retol)
+    )
+    if jax.process_count() > 1:
+        # Host slopes carry rank-local relay jitter, so ranks can
+        # disagree on want_remeasure — and the chains below run global
+        # collectives: a rank-local decision would send only SOME
+        # ranks back into them and deadlock the job at the first
+        # ppermute. Broadcast rank 0's decision so every rank takes
+        # the same branch (advisor r3 #1). Unconditional — gating the
+        # broadcast on the local decision would itself desynchronize.
+        import numpy as _np
+        from jax.experimental import multihost_utils
+        want_remeasure = bool(
+            multihost_utils.broadcast_one_to_all(
+                _np.asarray(want_remeasure))
+        )
+    if want_remeasure:
         # Disagreement beyond the re-measure band: one of the two
-        # caught a bad period. Re-run both; average the device slopes
-        # (device time is stable — two captures bound the truth) and
-        # take the fresher host number for the diagnostic.
+        # caught a bad period. Re-run both, interleaved in time, and
+        # pick the device slope by which capture its own host pair
+        # vouches for (advisor r3 #4: averaging in a corrupted first
+        # capture retains half its error).
         s2 = host_slope()
         try:
             dev2, note2 = device_slope()
@@ -473,7 +515,38 @@ def measure_headline(
             dev2, note2 = None, "re-measure capture timed out"
         remeasured = True
         if dev2 is not None:
-            dev = (dev + dev2) / 2.0
+            host2 = s2.mean_region if not s2.timed_out else float("nan")
+            pair2_ok = (
+                host2 == host2 and host2 > 0
+                and (1.0 / retol) <= dev2 / host2 <= retol
+            )
+            captures_consistent = (
+                dev is not None and dev > 0
+                and (1.0 / retol) <= dev2 / dev <= retol
+            )
+            if dev is None:
+                # This rank's first capture failed but a peer's
+                # disagreement forced the re-measure (the broadcast
+                # overrides the local gate): the fresh capture is the
+                # only one there is.
+                dev = dev2
+            elif captures_consistent:
+                # Both captures bound the truth: average.
+                dev = (dev + dev2) / 2.0
+            elif pair2_ok:
+                # The fresh capture agrees with its own host pair and
+                # the first didn't — the first capture was the
+                # corrupted one (stall/recompile in-window).
+                dev = dev2
+            else:
+                # No agreement signal at all. Corruption (a stall or a
+                # recompile caught in-window) only ever inflates
+                # device time, so the smaller capture is the cleaner.
+                dev = min(dev, dev2)
+        # The re-measure's note wins even when its capture failed —
+        # "re-measure capture timed out" is the one signal that the
+        # published first-capture slope was never re-confirmed.
+        if note2 is not None:
             note = note2
         if not s2.timed_out and s2.mean_region == s2.mean_region:
             host = s2.mean_region
