@@ -240,6 +240,41 @@ def test_flash_gqa_rejects_non_divisible_heads():
         F.flash_attention(q, k, v, True)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("h_kv", [2, 1])
+def test_fused_backward_matches_two_kernel(causal, h_kv):
+    # The fused single-kernel backward (partial-dq slabs + the
+    # segment-reduce) must agree with the two-kernel FA2 form it
+    # replaced — bit-identical on-chip (same f32 accumulation order);
+    # interpret mode gets a tight tolerance. Multi-tile shapes so the
+    # flat table/slab indexing is actually exercised, GQA included.
+    b, h, t, d = 1, 2, 256, 32
+    q, k, v = _qkv(b=b, h=h, t=t, d=d)
+    k, v = k[:, :h_kv], v[:, :h_kv]
+    do = _qkv(b=b, h=h, t=t, d=d, seed=3)[0]
+    out, (_, _, _, _, L) = F._flash_fwd(q, k, v, causal, None)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).reshape(b * h, t)
+    bq, bk = F._bwd_blocks(t, t, d)
+    q3 = q.reshape(b * h, t, d)
+    k3 = k.reshape(b * h_kv, t, d)
+    v3 = v.reshape(b * h_kv, t, d)
+    do3 = do.reshape(b * h, t, d)
+    outs = {}
+    for fused in (False, True):
+        outs[fused] = F._flash_bwd_call(
+            q3, k3, v3, do3, L.reshape(b * h, t), delta, 0, 0,
+            causal=causal, block_q=bq, block_k=bk, q_heads=h,
+            interpret=True, band_ok=True, fused=fused,
+        )
+    for name, a, bb in zip(("dq", "dk", "dv"), outs[False], outs[True]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bb), atol=1e-5, rtol=1e-5,
+            err_msg=f"{name} fused != two-kernel",
+        )
+
+
 def test_causal_cell_tables():
     """The flat-grid live-cell tables (one builder, both major orders):
     full/liveness boundary arithmetic and the seed flags, including the

@@ -690,9 +690,34 @@ def _recompute_p(q, kblk, Lr, q_off, k_off, q_idx, k_idx, bq, bk, causal,
     return jnp.exp2(s - Lr * LOG2E)
 
 
+def _dq_reduce_kernel(tab_ref, slab_ref, dq_ref):
+    """Sum the fused backward's per-cell partial-dq slabs into dq.
+
+    Grid = (bh, q-major live cell): the dq output block is revisited
+    consecutively across each q tile's run of cells (the forward's
+    o/m/l residency trick), so each dq tile is seeded once, accumulated
+    in f32 on the VPU, and flushed once — one DMA-bound pass over the
+    slab. Replaces a one-hot matmul reduction: the MXU truncates f32
+    inputs to bf16 at default precision (measured 2.5e-3 rel err on
+    dq), and HIGHEST-precision emulation costs ~0.5 ms at the bench
+    shape; f32 adds are exact and free by comparison.
+
+    ``tab_ref [3, n_cells]``: (k-major slab index of this q-major
+    cell, first-of-q-tile?, q tile index).
+    """
+    c = pl.program_id(1)
+
+    @pl.when(tab_ref[1, c] == 1)
+    def _seed():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+    dq_ref[0] += slab_ref[0, 0]
+
+
 def _bwd_dkdv_kernel(offs_ref, q_ref, do_ref, L_ref, dl_ref, k_ref, v_ref,
-                     dk_ref, dv_ref, *, causal: bool, window, band,
-                     n_q_tiles, scale: float, flat: bool = False):
+                     dk_ref, dv_ref, *maybe_dqp, causal: bool, window, band,
+                     n_q_tiles, scale: float, flat: bool = False,
+                     fused: bool = False):
     """Grid cell = (batch*head, KV block, q block) — q innermost, so the
     f32 dk/dv output tiles stay VMEM-resident across the whole q sweep
     (same revisiting trick as the forward's o/m/l). ``band``: windowed
@@ -704,6 +729,14 @@ def _bwd_dkdv_kernel(offs_ref, q_ref, do_ref, L_ref, dl_ref, k_ref, v_ref,
     scalar-prefetched table in ``offs_ref`` (``[4, n_cells]``: k tile,
     q tile, full?, first-of-k-tile?) — no dead steps, no dead DMA,
     zero offsets by contract (see :func:`_kernel_flat`).
+
+    ``fused``: one extra output ref carries the per-cell *partial* dq
+    slab ``ds·K`` (own block per grid cell — written once, never
+    revisited; Pallas has no cross-step output accumulation to a
+    non-consecutively revisited block, so the caller sums the slabs in
+    XLA). This reuses the P/dP already computed here, letting the
+    caller skip the dq kernel's S-recompute matmul, its exp sweep, and
+    its dP matmul (``docs/flash_ceiling.md``'s deferred lever).
     """
     bq = q_ref.shape[1]
     bk = k_ref.shape[1]
@@ -746,6 +779,14 @@ def _bwd_dkdv_kernel(offs_ref, q_ref, do_ref, L_ref, dl_ref, k_ref, v_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if fused:
+            # Same formula (and the same ds cast) as _bwd_dq_kernel's
+            # accumulation — straight assignment: this grid cell owns
+            # the whole output block.
+            maybe_dqp[0][0, 0] = jax.lax.dot_general(
+                ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
 
     if not causal:
         _accumulate(masked=False)
@@ -902,11 +943,12 @@ def _flash_bwd_jax(q3, k3, v3, do3, L, delta, q_off, k_off, *,
 @functools.partial(
     jax.jit,
     static_argnames=("causal", "window", "block_q", "block_k", "q_heads",
-                     "interpret", "band_ok"),
+                     "interpret", "band_ok", "fused"),
 )
 def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
                     causal: bool, block_q: int, block_k: int, q_heads: int,
-                    interpret: bool, window=None, band_ok: bool = False):
+                    interpret: bool, window=None, band_ok: bool = False,
+                    fused=None):
     """dq/dk/dv (f32) for one attention block, FlashAttention-2 style.
 
     ``L [bh, Tq]`` is the forward's logsumexp, ``delta [bh, Tq]`` the
@@ -915,6 +957,15 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
     *per query head* (``B·H_q`` rows) and the caller sums each group —
     keeping the kernel's output-revisiting pattern identical to MHA at
     the cost of a factor-``group`` f32 write the XLA-level sum folds.
+
+    ``fused`` (default auto): single-kernel backward — the dkdv sweep
+    emits per-cell partial-dq slabs (``ds·K``, reusing the P/dP it
+    already computed) and an XLA reduction sums them, replacing the dq
+    kernel's S-recompute matmul + exp sweep + dP matmul with HBM
+    traffic (the slab write + read). Applies where every grid cell is
+    live: the flat causal sweep and the rectangular non-causal sweep;
+    banded/windowed and nonzero-offset sweeps keep the two-kernel
+    form. See ``docs/flash_ceiling.md`` for the A/B.
     """
     if interpret and _vma_of(q3, k3, v3, do3, L, delta):
         return _flash_bwd_jax(q3, k3, v3, do3, L, delta, q_off, k_off,
@@ -950,6 +1001,8 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
     # k-major cells for dkdv (dk/dv tiles revisit consecutively),
     # q-major for dq. Zero offsets by the band_ok contract.
     flat = causal and window is None and band_ok
+    fused_ok = flat or (not causal and window is None)
+    fused = fused_ok if fused is None else (bool(fused) and fused_ok)
 
     def _promote(a):
         # Fresh table constants must match the operands' union vma.
@@ -970,9 +1023,11 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
         )
 
     if flat:
-        tab_k = _promote(jnp.asarray(_causal_cells(
+        cells_k = _causal_cells(
             n_q_tiles, tk // block_k, block_q, block_k, major="k"
-        )))
+        )  # trace-time numpy — also feeds the fused path's q-major ->
+        # k-major slab position mapping for _dq_reduce_kernel
+        tab_k = _promote(jnp.asarray(cells_k))
         kmaj_q = lambda i, c, t: (i, t[1, c], 0)  # noqa: E731
         kmaj_k = lambda i, c, t: (kvrow(i), t[0, c], 0)  # noqa: E731
         kmaj_out = lambda i, c, t: (i, t[0, c], 0)  # noqa: E731
@@ -991,10 +1046,16 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
             out_specs=[
                 pl.BlockSpec((1, block_k, d), kmaj_out),  # dk (resident)
                 pl.BlockSpec((1, block_k, d), kmaj_out),  # dv (resident)
-            ],
+            ] + ([
+                # Partial-dq slab: one block per grid cell, never
+                # revisited (written once by its owning cell).
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda i, c, t: (i, c, 0, 0)),
+            ] if fused else []),
         )
         dkdv_scalar = tab_k
-        dkdv_flops = 6 * bh * n_cells * block_q * block_k * d
+        dkdv_flops = (8 if fused else 6) * bh * n_cells * block_q * block_k * d
+        dqp_shape = (bh, n_cells, block_q, d)
     else:
         dkdv_grid = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -1011,26 +1072,94 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
             out_specs=[
                 pl.BlockSpec((1, block_k, d), qmap(first)),  # dk (resident)
                 pl.BlockSpec((1, block_k, d), qmap(first)),  # dv (resident)
-            ],
+            ] + ([
+                # Rectangular non-causal sweep: slab indexed (kb, qt).
+                pl.BlockSpec((1, 1, block_q, d),
+                             lambda i, a, b, s: (i, a, b, 0)),
+            ] if fused else []),
         )
         dkdv_scalar = offs
-        dkdv_flops = 6 * bh * tq * tk * d
-    dk, dv = pl.pallas_call(
+        dkdv_flops = (8 if fused else 6) * bh * tq * tk * d
+        dqp_shape = (bh, tk // block_k, tq, d)
+    dkdv_out = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, causal=causal, window=window,
                           band=band, n_q_tiles=n_q_tiles, scale=scale,
-                          flat=flat),
+                          flat=flat, fused=fused),
         grid_spec=dkdv_grid,
         out_shape=[
             jax.ShapeDtypeStruct((bh, tk, d), jnp.float32, vma=vma),
             jax.ShapeDtypeStruct((bh, tk, d), jnp.float32, vma=vma),
-        ],
+        ] + ([
+            jax.ShapeDtypeStruct(dqp_shape, jnp.float32, vma=vma),
+        ] if fused else []),
         cost_estimate=pl.CostEstimate(
             flops=dkdv_flops,
-            bytes_accessed=2 * bh * (2 * tq + 2 * tk) * d * q3.dtype.itemsize,
-            transcendentals=dkdv_flops // (6 * d),
+            # Fused adds the partial-dq slab write — the dominant
+            # extra HBM cost (f32, one block per grid cell).
+            bytes_accessed=(
+                2 * bh * (2 * tq + 2 * tk) * d * q3.dtype.itemsize
+                + (4 * dqp_shape[0] * dqp_shape[1] * dqp_shape[2]
+                   * dqp_shape[3] if fused else 0)
+            ),
+            transcendentals=dkdv_flops // ((8 if fused else 6) * d),
         ),
         interpret=interpret,
     )(dkdv_scalar, q3, do3, L, delta, k3, v3)
+    if fused:
+        dk, dv, dqp = dkdv_out
+        if flat:
+            # Segment-reduce the slabs by q tile with the revisiting
+            # Pallas kernel (see _dq_reduce_kernel). The q-major cell
+            # table (same builder as the dq kernel's sweep) is mapped
+            # to k-major slab positions at trace time; dead seed-only
+            # k-tile cells are simply never referenced.
+            import numpy as np
+
+            cells_q = _causal_cells(
+                n_q_tiles, tk // block_k, block_q, block_k
+            )
+            pos = {
+                (int(cells_k[0, c]), int(cells_k[1, c])): c
+                for c in range(n_cells)
+            }
+            n_cells_q = cells_q.shape[1]
+            red = np.empty((3, n_cells_q), np.int32)
+            for c in range(n_cells_q):
+                j, kb = int(cells_q[0, c]), int(cells_q[1, c])
+                red[0, c] = pos[(kb, j)]
+                red[1, c] = int(cells_q[3, c])
+                red[2, c] = j
+            red_tab = _promote(jnp.asarray(red))
+            (dq,) = pl.pallas_call(
+                _dq_reduce_kernel,
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=1,
+                    grid=(bh, n_cells_q),
+                    in_specs=[
+                        pl.BlockSpec((1, 1, block_q, d),
+                                     lambda i, c, t: (i, t[0, c], 0, 0)),
+                    ],
+                    out_specs=[
+                        pl.BlockSpec((1, block_q, d),
+                                     lambda i, c, t: (i, t[2, c], 0)),
+                    ],
+                ),
+                out_shape=[
+                    jax.ShapeDtypeStruct((bh, tq, d), jnp.float32,
+                                         vma=vma),
+                ],
+                cost_estimate=pl.CostEstimate(
+                    flops=bh * n_cells_q * block_q * d,
+                    bytes_accessed=4 * bh * (n_cells_q + n_q_tiles)
+                    * block_q * d,
+                    transcendentals=0,
+                ),
+                interpret=interpret,
+            )(red_tab, dqp)
+        else:
+            dq = dqp.sum(axis=1)
+        return dq, dk, dv
+    dk, dv = dkdv_out
 
     def kv_band_map(row=lambda i: i):
         # dq: fetch k tile a - (band-1) + b (clamped); middle index = q tile.
