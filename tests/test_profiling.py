@@ -55,6 +55,54 @@ def test_top_level_extraction_nested_and_host_filtered(tmp_path):
     assert tops[0].ts < tops[1].ts
 
 
+def test_device_op_events_depth1_only(tmp_path):
+    # Depth-1 rows are the op level; depth-2 sub-events must be
+    # excluded or any aggregation double-counts the parent's duration.
+    events = [
+        _meta(3, "/device:TPU:0"),
+        _ev(3, 1, "jit_step(1)", 100.0, 100.0),   # program (top)
+        _ev(3, 1, "fusion.1", 105.0, 40.0),       # op (depth 1)
+        _ev(3, 1, "subtile", 110.0, 10.0),        # depth 2: excluded
+        _ev(3, 1, "copy.2", 150.0, 20.0),         # op (depth 1)
+        _ev(3, 1, "jit_step(1)", 300.0, 50.0),    # second program
+        _ev(3, 1, "custom-call.3", 310.0, 30.0),  # op (depth 1)
+    ]
+    ops = P.device_op_events(_write_trace(tmp_path, events))
+    assert [o.name for o in ops] == ["fusion.1", "copy.2", "custom-call.3"]
+
+
+def test_op_category_breakdown(tmp_path):
+    events = [
+        _meta(3, "/device:TPU:0"),
+        _ev(3, 1, "jit_step(1)", 100.0, 200.0),
+        _ev(3, 1, "fusion.1", 105.0, 40.0),
+        _ev(3, 1, "copy.2", 150.0, 20.0),
+        _ev(3, 1, "custom-call.3", 175.0, 30.0),
+        _ev(3, 1, "all-reduce.4", 210.0, 10.0),
+        _ev(3, 1, "dynamic-update-slice.5", 225.0, 5.0),
+    ]
+    got = P.op_category_breakdown(_write_trace(tmp_path, events))
+    assert got["fusion"]["seconds"] == pytest.approx(40e-6)
+    assert got["copy"]["seconds"] == pytest.approx(25e-6)  # copy + DUS
+    assert got["kernel"]["seconds"] == pytest.approx(30e-6)
+    assert got["collective"]["seconds"] == pytest.approx(10e-6)
+    assert got["fusion"]["top"][0][0] == "fusion.1"
+    # Window clipping: only events inside the second half.
+    got2 = P.op_category_breakdown(
+        _write_trace(tmp_path, events), window=(200e-6, 300e-6)
+    )
+    assert set(got2) == {"collective", "copy"}
+
+
+def test_categorize_op_rules():
+    assert P.categorize_op("fusion.12") == "fusion"
+    assert P.categorize_op("copy-start.3") == "copy"
+    assert P.categorize_op("custom-call.7") == "kernel"
+    assert P.categorize_op("collective-permute-start.1") == "collective"
+    assert P.categorize_op("dot.5") == "matmul"
+    assert P.categorize_op("weird-op") == "other"
+
+
 def test_differential_from_trace_slope(tmp_path):
     # short chain (2 ops) averages 31 us, long chain (10 ops) 111 us:
     # slope = (111 - 31) / 8 = 10 us/op. The readback fence's own

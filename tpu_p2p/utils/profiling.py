@@ -120,6 +120,105 @@ def device_top_level_events(trace_dir: str) -> List[DeviceEvent]:
     return out
 
 
+def device_op_events(trace_dir: str) -> List[DeviceEvent]:
+    """Op-level events on device tracks: device X-events nested at
+    depth exactly 1 inside a containing (program) event. These are
+    XLA's per-op rows (``fusion.N``, ``copy.N``,
+    ``dynamic-update-slice.N``, Pallas ``custom-call``s, collective
+    ops) — the raw material for attributing a step's device time by op
+    category. Depth-1 only: deeper nesting (an op's sub-events) would
+    double-count the parent's duration in any aggregation."""
+    xs, pid_names = load_trace_events(trace_dir)
+    dev_pids = {p for p, n in pid_names.items()
+                if str(n).startswith("/device:")}
+    by_track: dict = {}
+    for e in xs:
+        if e["pid"] in dev_pids:
+            by_track.setdefault((e["pid"], e["tid"]), []).append(e)
+    out: List[DeviceEvent] = []
+    for (pid, tid), evs in by_track.items():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        ends: list = []  # stack of enclosing-interval end times
+        for e in evs:
+            while ends and e["ts"] >= ends[-1]:
+                ends.pop()
+            if len(ends) == 1:  # direct child of a top-level event
+                out.append(DeviceEvent(
+                    name=e.get("name", ""), ts=e["ts"] / 1e6,
+                    dur=e["dur"] / 1e6, pid=pid, tid=tid,
+                ))
+            ends.append(e["ts"] + e["dur"])
+    out.sort(key=lambda d: d.ts)
+    return out
+
+
+# Op-name → category rules for roofline attribution, checked in order
+# (first match wins). Rules are prefix/substring heuristics over XLA's
+# HLO op names as they appear on the device track; "fusion" is the
+# catch-all XLA bucket for fused elementwise+matmul regions, so it is
+# matched LAST among compute ops and callers should read it as "fused
+# compute (matmul and/or elementwise)".
+OP_CATEGORY_RULES = (
+    ("collective", ("all-reduce", "all-gather", "all-to-all",
+                    "collective-permute", "reduce-scatter",
+                    "collective")),
+    ("copy", ("copy", "bitcast", "transpose", "slice", "concatenate",
+              "dynamic-update-slice", "dynamic-slice", "pad", "gather",
+              "scatter", "reshape", "broadcast")),
+    ("kernel", ("custom-call",)),  # Pallas kernels land here
+    ("matmul", ("dot", "convolution", "cublas", "gemm")),
+    ("fusion", ("fusion", "loop_", "input_", "output_")),
+)
+
+
+def categorize_op(name: str) -> str:
+    """Map one device op-event name to a roofline category."""
+    base = name.lower()
+    for cat, subs in OP_CATEGORY_RULES:
+        for s in subs:
+            if s in base:
+                return cat
+    return "other"
+
+
+def op_category_breakdown(trace_dir: str, window=None):
+    """Aggregate device op time by category → ``{category:
+    {"seconds": total, "count": n, "top": [(name, seconds), ...]}}``.
+
+    ``window``: optional ``(t0, t1)`` seconds clipping to one program
+    execution (e.g. a single step picked from
+    :func:`device_top_level_events`) so warm-up and neighboring
+    programs do not pollute the attribution. Events are counted on the
+    lowest device pid only (multi-device traces repeat every program
+    per track; see :func:`differential_from_trace`).
+    """
+    evs = device_op_events(trace_dir)
+    if not evs:
+        return {}
+    pid0 = min(e.pid for e in evs)
+    evs = [e for e in evs if e.pid == pid0]
+    if window is not None:
+        t0, t1 = window
+        evs = [e for e in evs if t0 <= e.ts and e.ts + e.dur <= t1]
+    out: dict = {}
+    per_name: dict = {}
+    for e in evs:
+        cat = categorize_op(e.name)
+        d = out.setdefault(cat, {"seconds": 0.0, "count": 0})
+        d["seconds"] += e.dur
+        d["count"] += 1
+        key = (cat, e.name)
+        per_name[key] = per_name.get(key, 0.0) + e.dur
+    for cat, d in out.items():
+        tops = sorted(
+            ((n, s) for (c, n), s in per_name.items() if c == cat),
+            key=lambda kv: -kv[1],
+        )[:5]
+        d["top"] = [(n, round(s, 9)) for n, s in tops]
+        d["seconds"] = round(d["seconds"], 9)
+    return out
+
+
 def differential_from_trace(trace_dir: str, n_short: int, n_long: int,
                             runs: int = 1,
                             is_program=None) -> float:
@@ -275,6 +374,65 @@ def validate_differential(
         host_per_op_s=host, device_per_op_s=dev, ratio=ratio, tol=tol,
         n_short=short, n_long=iters, note=note,
     )
+
+
+def one_op_program_p50(f, x, runs: int = 48, timeout_s=None):
+    """p50 device-timeline span of a whole single-op program —
+    the dispatch-inclusive latency analogue.
+
+    The scan-floor latency (``loopback_chain`` slope) deliberately
+    measures only the scan *body*: no launch, no program setup, no
+    drain. The reference's per-message metric is the opposite — it
+    includes send-launch overhead and a full drain per message
+    (`/root/reference/p2p_matrix.cc:153-177`, SURVEY §3.3 calls it
+    "latency-inclusive"). This measures that: ``f`` compiles to one
+    executable containing exactly one op; every execution's top-level
+    device span is collected from one trace capture and the p50
+    published. Spans are execution durations (queue wait excluded), so
+    back-to-back enqueue is fine; one fence after the last call orders
+    the trace close behind the final program on the stream.
+
+    Returns ``(p50_seconds, n_spans)`` or ``(None, 0)`` when the
+    platform records no device track (CPU test meshes). The target
+    program is identified by occurrence count — the fence's own jitted
+    helpers appear once, the target ``runs`` times.
+    """
+    import statistics as stats
+    import tempfile
+    from collections import Counter
+
+    import jax
+
+    from tpu_p2p.utils import timing as timing_mod
+
+    out = f(x)
+    timing_mod.run_fenced(out, timeout_s)  # compile + warm, untraced
+    with tempfile.TemporaryDirectory(prefix="oneop_") as td:
+        with jax.profiler.trace(td):
+            # Fence every 8 runs: spans exclude queue wait either way,
+            # but a deep queue of collective programs can starve a
+            # participant thread on the in-process CPU backend past
+            # XLA's 40 s rendezvous limit — a CHECK-fail abort, not an
+            # exception (measured: 48 queued 8-device ppermutes under
+            # machine load). Chunking also keeps the fence helpers'
+            # occurrence count well below the target's.
+            for i in range(runs):
+                out = f(x)
+                if (i + 1) % 8 == 0:
+                    timing_mod.run_fenced(out, timeout_s)
+            timing_mod.run_fenced(out, timeout_s)
+        evs = device_top_level_events(td)
+    if not evs:
+        return None, 0
+    # Lowest device pid only: multi-device traces record every program
+    # once per track, which would inflate the published span count by
+    # the device count (same rule as differential_from_trace).
+    pid0 = min(e.pid for e in evs)
+    evs = [e for e in evs if e.pid == pid0]
+    counts = Counter(e.name for e in evs)
+    name, _ = counts.most_common(1)[0]
+    durs = [e.dur for e in evs if e.name == name]
+    return float(stats.median(durs)), len(durs)
 
 
 @dataclass
@@ -543,10 +701,15 @@ def measure_headline(
                 # recompile caught in-window) only ever inflates
                 # device time, so the smaller capture is the cleaner.
                 dev = min(dev, dev2)
-        # The re-measure's note wins even when its capture failed —
-        # "re-measure capture timed out" is the one signal that the
-        # published first-capture slope was never re-confirmed.
-        if note2 is not None:
+        # The re-measure's note replaces the first capture's whenever
+        # the re-measure produced a value OR a diagnosis: a successful
+        # dev2 clears a stale "trace capture failed" from a first
+        # capture the published number no longer rests on, and
+        # "re-measure capture timed out" is the one signal that a
+        # published first-capture slope was never re-confirmed. Only a
+        # silent no-track re-measure (dev2 None, note2 None) keeps the
+        # original note.
+        if dev2 is not None or note2 is not None:
             note = note2
         if not s2.timed_out and s2.mean_region == s2.mean_region:
             host = s2.mean_region
