@@ -89,6 +89,18 @@ def main() -> None:
     else:
         raise AssertionError("divergent resume sets were not detected")
 
+    # The same divergence through the REAL CLI (r3 verdict next #7):
+    # rank 0 resumes from the populated shared log, rank 1 from an
+    # empty per-rank view (the advisor's original per-host-local-path
+    # scenario) — the run must die with the agreement error on BOTH
+    # ranks, before any per-cell barrier can desynchronize.
+    my_jsonl = jsonl if pid == 0 else jsonl + f".rank{pid}-local"
+    rc = cli_main(["--pattern", "pairwise", "--direction", "uni",
+                   "--msg-size", "8KiB", "--iters", "2",
+                   "--jsonl", my_jsonl, "--resume"])
+    assert rc != 0, "divergent --resume views must fail the run"
+    print(f"RESUME-DIVERGENCE-DETECTED rc={rc}", flush=True)
+
     rt.barrier("2proc-done")
     print(f"WORKER{pid} DONE", flush=True)
 

@@ -105,3 +105,11 @@ def test_two_process_distributed_run(tmp_path):
     dev_ring = [r for r in ring_recs if r["mode"] == "device"]
     assert len(dev_ring) == 1
     assert dev_ring[0]["source"] == "host_differential"
+    # The divergent --resume CLI run died with the agreement error on
+    # BOTH ranks (rank 1 resumed from an empty per-rank view) — the
+    # advisor's hang scenario is now an immediate cross-process error,
+    # pinned through the real CLI, not a mocked unit path.
+    for i, out in enumerate((rank0_out, rank1_out)):
+        assert "RESUME-DIVERGENCE-DETECTED" in out, (
+            f"rank {i} did not detect the divergent resume set:\n{out}"
+        )
