@@ -104,3 +104,24 @@ def test_decode_rejects_sp_or_pp_mesh():
         D.make_flagship_decode_step(_mesh(sp=2), cfg)
     with pytest.raises(ValueError, match="pp axis size 1"):
         D.init_kv_cache(cfg, 8, _mesh(pp=2))
+
+
+def test_cache_row_write_matches_dus():
+    # The aliased Pallas band write must byte-match the DUS it
+    # replaces, across band boundaries, stages, and both ends of the
+    # time axis (interpret mode, no shard_map — the sharded CPU path
+    # takes the DUS fallback; the Pallas path runs on TPU).
+    S, B, H, T, Dh = 2, 2, 2, 64, 64
+    rng = np.random.default_rng(0)
+    c0 = jnp.asarray(rng.standard_normal((S, B, H, T, Dh)), jnp.bfloat16)
+    slab = jnp.asarray(rng.standard_normal((B, H, 1, Dh)), jnp.bfloat16)
+    for stage in (0, 1):
+        f = jax.jit(
+            lambda c, s, p, st=stage: D._cache_row_write(c, s, p, st)
+        )
+        for pos in (0, 7, 8, 37, T - 1):
+            got = f(c0, slab, pos)
+            want = jax.lax.dynamic_update_slice(
+                c0, slab[None].astype(c0.dtype), (stage, 0, 0, pos, 0)
+            )
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
