@@ -124,6 +124,17 @@ class FlagshipConfig:
             d_model=self.model_dim, d_ff=self.moe_mult * self.model_dim,
             num_experts=self.num_experts,
             capacity_factor=self.capacity_factor,
+            # Routing-group width 256: the dispatch one-hot masks and
+            # their einsum flops are linear in gs — the r4 device
+            # ladder on the bench step (B·T=8k, E=4) measured
+            # 1024→5.95, 512→5.53, 256→5.29, 128→5.27 ms/step; 256
+            # takes the 11% before the plateau
+            # (docs/step_roofline.md). Capacity stays 2x the
+            # per-group mean at any gs (~9 sigma above the binomial
+            # mean here); the tradeoff is a shorter same-expert burst
+            # length before per-group capacity drops, acceptable for
+            # this model family — the library default stays 1024.
+            group_size=256,
         )
 
     def tiny(self, mesh: Mesh) -> "FlagshipConfig":

@@ -59,8 +59,12 @@ class MoEConfig:
     # capacity is enforced per group of this many tokens, so the
     # one-hot dispatch/combine tensors are [gs, E, C(gs)] per group —
     # O(G·gs) total instead of the O(G²) a single all-token group
-    # costs once C grows with G (measured: the dispatch einsums
-    # dominated the flagship step's time at B·T >= 4k tokens).
+    # costs once C grows with G. Both the mask bytes AND the
+    # dispatch-einsum flops are linear in gs — smaller groups are
+    # faster (the r4 flagship ladder: 1024→5.95, 256→5.29 ms/step) but
+    # shorten the same-expert burst length that starts dropping
+    # (capacity is per group), so the library default stays at 1024
+    # and speed-tuned callers opt down (FlagshipConfig.moe() → 256).
 
     def capacity(self, tokens: int) -> int:
         """Per-expert slot count for ``tokens`` routed tokens (each
