@@ -619,9 +619,14 @@ def _loopback_size_sweep(timing, cache, rt, headline):
                 # per 2 GiB moved, 4x the 256 MiB op time exactly),
                 # but the chained slope carries ~3.3 ms/iter of
                 # device-side stall between scan iterations that the
-                # 256 MiB chain does not have. The published number is
-                # honest end-to-end chained throughput; the label says
-                # the op itself is not the limiter.
+                # 256 MiB chain does not have — one hidden full-buffer
+                # round trip's worth. The stall is not fundamental: an
+                # optimization_barrier'd scan body sustains a uniform
+                # 536 GB/s at BOTH 256 MiB and 1 GiB (measured r4),
+                # but that variant costs the 256 MiB headline its 657,
+                # so the unbarriered chain stays. The published number
+                # is honest end-to-end chained throughput; the label
+                # says the op itself is not the limiter.
                 r["regime"] = "hbm_chain_stall"
             else:
                 r["regime"] = "hbm"
