@@ -274,6 +274,34 @@ def differential_from_trace(trace_dir: str, n_short: int, n_long: int,
     return (means[1] - means[0]) / (n_long - n_short)
 
 
+def _slope_verdict(host_per_op_s, device_per_op_s, ratio, tol,
+                   note) -> Optional[bool]:
+    """Shared host-vs-device slope verdict — the ONE implementation
+    behind both :class:`TimingValidation.ok` and
+    :class:`HeadlineMeasurement.ok` so the CLI validate-timing verdict
+    and the headline-measurement verdict cannot drift apart.
+
+    - no device track: ``note`` set (track present but slope not
+      extractable — a failure on the hardware the check exists for) →
+      False; otherwise unjudged (None — the CPU test mesh).
+    - degenerate device slope → False.
+    - degenerate *host* slope next to a healthy device slope →
+      unjudged (None): the relay's clock cannot resolve a few-µs
+      per-op time (observed live: a 4 MiB VMEM-resident loopback
+      reads 0.000 host vs 3.544 device µs/op), which is the
+      diagnostic failing, not the device number — branding it a
+      MISMATCH would let noise refute the published value.
+    - else: the ratio band.
+    """
+    if device_per_op_s is None:
+        return False if note else None
+    if not device_per_op_s > 0:
+        return False
+    if not host_per_op_s > 0:  # NaN or nonpositive diagnostic
+        return None
+    return (1.0 / tol) <= ratio <= tol
+
+
 @dataclass
 class TimingValidation:
     host_per_op_s: float
@@ -289,13 +317,9 @@ class TimingValidation:
 
     @property
     def ok(self) -> Optional[bool]:
-        """True/False when a device track exists; None when it cannot
-        be judged (no device events — e.g. the simulated CPU mesh)."""
-        if self.device_per_op_s is None:
-            return False if self.note else None
-        if not (self.host_per_op_s > 0 and self.device_per_op_s > 0):
-            return False
-        return (1.0 / self.tol) <= self.ratio <= self.tol
+        """See :func:`_slope_verdict`."""
+        return _slope_verdict(self.host_per_op_s, self.device_per_op_s,
+                              self.ratio, self.tol, self.note)
 
     def describe(self) -> str:
         if self.device_per_op_s is None:
@@ -304,8 +328,16 @@ class TimingValidation:
                         f"present but slope not extractable — {self.note}")
             return ("timing-validation: no device track in trace "
                     "(platform records host events only) — not judged")
-        verdict = "OK" if self.ok else "MISMATCH"
         ratio = f"{self.ratio:.3f}" if self.ratio is not None else "n/a"
+        if self.ok is None:
+            return (
+                "timing-validation[UNJUDGED]: host differential "
+                f"degenerate ({self.host_per_op_s * 1e6:.3f} us/op — "
+                "relay clock cannot resolve this per-op time); "
+                f"device-trace {self.device_per_op_s * 1e6:.3f} us/op "
+                "stands"
+            )
+        verdict = "OK" if self.ok else "MISMATCH"
         return (
             f"timing-validation[{verdict}]: host-differential "
             f"{self.host_per_op_s * 1e6:.3f} us/op vs device-trace "
@@ -475,14 +507,10 @@ class HeadlineMeasurement:
         number is the published one; branding it "validation failed"
         because the diagnostic was noise would reintroduce the
         self-refuting artifact this class exists to prevent.
+        (Shared implementation: :func:`_slope_verdict`.)
         """
-        if self.device_per_op_s is None:
-            return False if self.note else None
-        if not self.device_per_op_s > 0:
-            return False
-        if not self.host_per_op_s > 0:  # NaN or nonpositive diagnostic
-            return None
-        return (1.0 / self.tol) <= self.ratio <= self.tol
+        return _slope_verdict(self.host_per_op_s, self.device_per_op_s,
+                              self.ratio, self.tol, self.note)
 
     def as_samples(self):
         """Adapter to the :class:`tpu_p2p.utils.timing.Samples` shape
