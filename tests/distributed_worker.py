@@ -75,6 +75,69 @@ def main() -> None:
         rc = cli_main(argv)
         assert rc == 0, f"{argv} -> rc {rc}"
 
+    # Execute measure_headline's re-measure fork for REAL across the
+    # two processes (r4 verdict weak #2: the want_remeasure broadcast
+    # and second-capture path could never run on CPU because device
+    # slopes are None, so the deadlock-avoidance logic was mock-tested
+    # only). Rank 0 injects a synthetic device timeline — a patched
+    # differential_from_trace returning a slope wildly disagreeing
+    # with its host slope — while rank 1 keeps the real (no-track)
+    # path. Rank 0 alone then wants a re-measure; the broadcast must
+    # drag BOTH ranks through the second host+device capture (global
+    # collective chains) without deadlock.
+    from tpu_p2p.utils import profiling as prof
+    from tpu_p2p.utils import timing as timing_mod
+
+    real_diff = prof.differential_from_trace
+    capture_calls = []
+
+    def fake_diff(td, n_short, n_long, runs=1, is_program=None):
+        capture_calls.append(1)
+        if pid == 0:
+            return 1.0  # synthetic: orders beyond the pinned host slope
+        return real_diff(td, n_short, n_long, runs=runs,
+                         is_program=is_program)
+
+    class PinnedHostTiming:
+        """Runs the REAL collective chains (the deadlock surface),
+        then pins the returned host slope to a fixed positive value so
+        rank 0's want_remeasure decision cannot be flipped by CPU
+        timing noise (a negative thin differential would silently
+        skip the fork this test exists to execute)."""
+
+        @staticmethod
+        def measure_differential(make_chain, x, iters, **kw):
+            s = timing_mod.measure_differential(make_chain, x, iters,
+                                                **kw)
+            s.iter_seconds = [1e-4] * max(1, s.count)
+            s.region_seconds = 1e-4 * max(1, s.count)
+            return s
+
+    prof.differential_from_trace = fake_diff
+    try:
+        m = prof.measure_headline(
+            lambda k: cache.permute_chain(rt.mesh, "d",
+                                          C.ring_edges(4), k),
+            C.make_payload(rt.mesh, 4096), 8, repeats=2, runs=1,
+            timing=PinnedHostTiming,
+        )
+    finally:
+        prof.differential_from_trace = real_diff
+    assert m.remeasured is True, (
+        f"rank {pid}: broadcast did not force the re-measure branch"
+    )
+    assert len(capture_calls) == 2, (
+        f"rank {pid}: expected 2 trace captures (first + re-measure), "
+        f"saw {len(capture_calls)}"
+    )
+    if pid == 0:
+        # Consistent synthetic captures average to themselves and win.
+        assert m.source == "device_trace" and m.per_op_s == 1.0, m
+    else:
+        # No device track either capture: the host slope publishes.
+        assert m.source == "host_differential" and m.per_op_s > 0, m
+    print(f"REMEASURE-FORK-OK rank{pid} source={m.source}", flush=True)
+
     # Resume-set agreement (advisor round-2 #3), for real: identical
     # sets pass, rank-divergent sets must raise on every rank instead
     # of deadlocking later at a per-cell barrier.

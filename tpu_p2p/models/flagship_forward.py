@@ -199,7 +199,8 @@ def _lm_logits_local(params, tokens, cfg: FlagshipConfig, axes):
     logits. Embedding and unembedding are position-independent, so
     they sit outside the pipeline schedule (every pp rank computes
     them on the replicated activations)."""
-    x = jnp.take(params["emb"], tokens, axis=0).astype(jnp.dtype(cfg.dtype))
+    compute = jnp.dtype(cfg.dtype)
+    x = jnp.take(params["emb"], tokens, axis=0).astype(compute)
     # The stack sees only stage-major leaves: _stage_block slices every
     # leaf by stage index; emb (vocab-leading) and lnf (stage-less) are
     # applied here around it.
@@ -207,8 +208,14 @@ def _lm_logits_local(params, tokens, cfg: FlagshipConfig, axes):
     y = _forward_local(stack, x, cfg, axes)
     if cfg.norm:
         y = _rms_norm(y, params["lnf"])
-    return jnp.einsum("btm,vm->btv", y.astype(jnp.float32),
-                      params["emb"].astype(jnp.float32))
+    # Unembed in the compute dtype with f32 accumulation: under bf16
+    # this keeps the [Dm, V] matmul on the MXU's native path (an f32
+    # matmul runs at a fraction of bf16 peak via emulation passes) —
+    # the classic mixed-precision LM head. Under f32 compute this is
+    # bit-identical to an all-f32 einsum.
+    return jnp.einsum("btm,vm->btv", y.astype(compute),
+                      params["emb"].astype(compute),
+                      preferred_element_type=jnp.float32)
 
 
 def make_flagship_lm_forward(mesh: Mesh, cfg: FlagshipConfig):

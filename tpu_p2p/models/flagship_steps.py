@@ -119,10 +119,20 @@ def make_flagship_lm_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
         def local_loss(p):
             pf = fsdp.all_gather_params(p, "dp", plan) if plan else p
             logits = _lm_logits_local(pf, tokens, cfg, axes)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.take_along_axis(logp, targets[..., None],
-                                       axis=-1)[..., 0]
-            return jnp.sum(nll)
+            # CE via logsumexp rather than materializing
+            # log_softmax's full [B, T, V] tensor: sum(nll) =
+            # sum(logsumexp(logits)) - sum(logits[target]) exactly
+            # (same max-shifted f32 math), and XLA fuses the rowwise
+            # reduction without a second vocab-sized intermediate —
+            # at production vocab (32k) that intermediate is GBs.
+            m = jax.lax.stop_gradient(
+                jnp.max(logits, axis=-1, keepdims=True)
+            )
+            lse = (m[..., 0]
+                   + jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1)))
+            tgt = jnp.take_along_axis(logits, targets[..., None],
+                                      axis=-1)[..., 0]
+            return jnp.sum(lse - tgt)
 
         loss, grads = jax.value_and_grad(local_loss)(params)
         data_axes = _data_axes(axes)

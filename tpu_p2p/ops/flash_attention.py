@@ -963,8 +963,10 @@ def _flash_bwd_call(q3, k3, v3, do3, L, delta, q_off, k_off, *,
     already computed) and an XLA reduction sums them, replacing the dq
     kernel's S-recompute matmul + exp sweep + dP matmul with HBM
     traffic (the slab write + read). Applies where every grid cell is
-    live: the flat causal sweep and the rectangular non-causal sweep;
-    banded/windowed and nonzero-offset sweeps keep the two-kernel
+    live: the flat causal sweep and the rectangular non-causal sweep
+    (offsets only move masking, which is inert in the unmasked
+    non-causal path); banded/windowed sweeps — and nonzero-offset
+    *causal* sweeps, which lose the flat grid — keep the two-kernel
     form. See ``docs/flash_ceiling.md`` for the A/B.
     """
     if interpret and _vma_of(q3, k3, v3, do3, L, delta):

@@ -650,7 +650,13 @@ def measure_headline(
     if any_rank(s.timed_out):
         return HeadlineMeasurement(
             per_op_s=None, source="none",
-            host_per_op_s=s.mean_region,  # nan when timed_out, by policy
+            # NaN whenever the COLLECTIVE verdict is timed_out: when a
+            # peer's timeout forces this return, the local rank's own
+            # host slope may be real, but the measurement as a whole is
+            # a marked cell — publishing a live-looking slope under
+            # timed_out=True would let ranks disagree about what the
+            # field means (advisor r4 #4).
+            host_per_op_s=float("nan"),
             device_per_op_s=None, ratio=None, tol=tol, n_short=short,
             n_long=iters, timed_out=True, host_samples=s,
         )
@@ -665,7 +671,10 @@ def measure_headline(
         dev, note, dev_timed_out = None, None, True
     if any_rank(dev_timed_out):
         return HeadlineMeasurement(
-            per_op_s=None, source="none", host_per_op_s=host,
+            # Same policy as the host-timeout return above: timed_out
+            # publishes no slopes, even though the host half completed
+            # here — a marked cell carries no live-looking numbers.
+            per_op_s=None, source="none", host_per_op_s=float("nan"),
             device_per_op_s=None, ratio=None, tol=tol, n_short=short,
             n_long=iters, timed_out=True, host_samples=s,
         )
