@@ -310,11 +310,16 @@ def _flagship_large_metrics(timing, mxu_peak_tflops):
 
     Config: 436 M params — Dm=2048 (16 heads x 128), GQA 2:1, 8
     blocks, dense 4x FFN, T=4096, vocab 32k, bf16, flash attention,
-    RoPE + RMSNorm, per-block remat — sized to train on one 16 GB
-    v5e. Chain-of-steps device-trace slope like every headline;
-    ``mfu`` = useful model flops (3x-fwd accounting, remat recompute
-    excluded) over measured time x the chip's own bf16 peak (null on
-    unknown chips, same policy as the HBM anchor)."""
+    RoPE + RMSNorm — sized to train on one 16 GB v5e WITHOUT remat
+    at microbatches=1 (the r5 device ladder, docs/probe_r5.py: full
+    remat @mb2 444.2 ms, dots-policy 415.7, noremat @mb1 360.3 —
+    remat's 1.28x recompute is the one >=1.2x lever at this shape and
+    the memory budget does not require paying it; remat remains a
+    tested feature for configs that do, tests/test_remat.py).
+    Chain-of-steps device-trace slope like every headline; ``mfu`` =
+    useful model flops (3x-fwd weights / 3.5x-fwd attention, any
+    recompute excluded) over measured time x the chip's own bf16 peak
+    (null on unknown chips, same policy as the HBM anchor)."""
     import functools
     import math
 
@@ -326,8 +331,8 @@ def _flagship_large_metrics(timing, mxu_peak_tflops):
     mesh = F.build_mesh(1, devices=jax.devices()[:1])
     cfg = F.FlagshipConfig(
         batch=4, seq=4096, heads=16, kv_heads=8, head_dim=128, stages=8,
-        microbatches=2, dense_ffn=True, moe_mult=4, vocab=32768,
-        rope=True, norm=True, use_flash=True, remat=True,
+        microbatches=1, dense_ffn=True, moe_mult=4, vocab=32768,
+        rope=True, norm=True, use_flash=True, remat=False,
         dtype="bfloat16",
     )
     params0 = F.place_flagship_params(F.init_flagship_params(cfg), mesh,
@@ -752,10 +757,12 @@ def _loopback_size_sweep(timing, cache, rt, headline):
 
     rows = []
     for nbytes, iters in _sweep_ladder(LOOPBACK_SWEEP_LADDER):
-        x = C.make_payload(rt.mesh, nbytes)
+        x = C.make_loopback_payload(rt.mesh, nbytes)
+        tr = x.ndim - len(rt.mesh.axis_names)
         try:
             m = _measure(
-                timing, lambda k: cache.loopback_chain(rt.mesh, k), x,
+                timing,
+                lambda k, tr=tr: cache.loopback_chain(rt.mesh, k, tr), x,
                 iters, repeats=3,
             )
         except Exception as e:  # noqa: BLE001
@@ -787,19 +794,20 @@ def _loopback_size_sweep(timing, cache, rt, headline):
                 r["regime"] = "overhead_bound"
             elif r["bytes"] > big and gb < 0.75 * ref:
                 # Above the headline size the tiny-buffer explanation
-                # cannot apply. Device-trace evidence (r4): the 1 GiB
-                # rewrite FUSION runs at the full ~657 GB/s (3.26 ms
-                # per 2 GiB moved, 4x the 256 MiB op time exactly),
-                # but the chained slope carries ~3.3 ms/iter of
-                # device-side stall between scan iterations that the
-                # 256 MiB chain does not have — one hidden full-buffer
-                # round trip's worth. The stall is not fundamental: an
-                # optimization_barrier'd scan body sustains a uniform
-                # 536 GB/s at BOTH 256 MiB and 1 GiB (measured r4),
-                # but that variant costs the 256 MiB headline its 657,
-                # so the unbarriered chain stays. The published number
-                # is honest end-to-end chained throughput; the label
-                # says the op itself is not the limiter.
+                # cannot apply. r4 called this rung a "chain stall";
+                # the r5 trace NAMED the mechanism and fixed it: the
+                # old (1, N) int8 payload's padded 1-row layout made
+                # the short chain compile to one 3.9x-slow fusion on
+                # the bad layout while the long chain bracketed its
+                # full-speed while loop with 33 ms of relayout ops
+                # (reduce 19.4 + reshape 4.0 + copy 9.7 at 1 GiB) —
+                # structurally different programs, so the differential
+                # slope (326 GB/s) was an artifact, not a stall.
+                # make_loopback_payload pre-shapes the streaming view,
+                # after which every count compiles to the while alone
+                # and the rung measures the true ~657 GB/s. The label
+                # is kept for artifact continuity: if it ever fires
+                # again, a layout change has re-split the programs.
                 r["regime"] = "hbm_chain_stall"
             else:
                 r["regime"] = "hbm"
@@ -991,9 +999,13 @@ def main() -> int:
         # HBM rewrites (read msg + write msg per op), differential,
         # published from the device timeline when one exists.
         big = 256 * 1024 * 1024
-        xb = C.make_payload(rt.mesh, big)
+        # Pre-shaped payload: the (1, N) row's padded layout must not
+        # sit inside the timed chain (see make_loopback_payload).
+        xb = C.make_loopback_payload(rt.mesh, big)
+        tr_b = xb.ndim - len(rt.mesh.axis_names)
         m = _measure(
-            timing, lambda k: cache.loopback_chain(rt.mesh, k), xb, iters,
+            timing,
+            lambda k: cache.loopback_chain(rt.mesh, k, tr_b), xb, iters,
             repeats=4,
         )
         per_op = m.per_op_s if m.per_op_s is not None else float("nan")

@@ -1,0 +1,197 @@
+"""Round-5 probe: (a) op-category attribution of the production-shape
+LM step (docs/step_roofline.md §large); (b) remat-variant ladder at the
+same shape; (c) the 1 GiB loopback chain-stall attribution
+(docs and bench.py regime note). Run on the real chip from /root/repo:
+
+    python docs/probe_r5.py attribution | remat_ladder | stall
+
+Kept in-repo so the numbers in the round-5 docs are reproducible.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))  # repo root, so `python docs/probe_r5.py ...` works
+
+import jax
+import jax.numpy as jnp  # noqa: E402,F401
+
+
+def large_cfg(**kw):
+    from tpu_p2p.models import flagship as F
+
+    base = dict(batch=4, seq=4096, heads=16, kv_heads=8, head_dim=128,
+                stages=8, microbatches=2, dense_ffn=True, moe_mult=4,
+                vocab=32768, rope=True, norm=True, use_flash=True,
+                remat=True, dtype="bfloat16")
+    base.update(kw)
+    return F.FlagshipConfig(**base)
+
+
+def _step_chain(cfg, n):
+    from tpu_p2p.models import flagship as F
+
+    mesh = F.build_mesh(1, devices=jax.devices()[:1])
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh,
+                                     cfg)
+    toks, tgts = F.flagship_token_batch(cfg, mesh)
+    step = F.make_flagship_lm_train_step(mesh, cfg, lr=1e-2)
+
+    @jax.jit
+    def chain(p):
+        def body(pp, _):
+            p2, loss = step(pp, toks, tgts)
+            return p2, loss
+
+        return jax.lax.scan(body, p, None, length=n)
+
+    return chain, params
+
+
+def attribution(**cfg_kw):
+    """Trace one 2-step chain of the graded large config; print the
+    per-step LEAF op-category table (the V8 re-attribution — depth-1
+    is one opaque `while` per scan at this shape)."""
+    from tpu_p2p.utils import profiling as P
+
+    n = 2
+    chain, params = _step_chain(large_cfg(**cfg_kw), n)
+    out = chain(params)
+    jax.block_until_ready(out)  # compile + warm outside the trace
+    with tempfile.TemporaryDirectory(prefix="attr_") as td:
+        with jax.profiler.trace(td):
+            jax.block_until_ready(chain(params))
+        tops = [t for t in P.device_top_level_events(td)
+                if t.name.startswith("jit")]
+        tops.sort(key=lambda t: -t.dur)
+        prog = tops[0]
+        print(f"program {prog.name} span {prog.dur * 1e3:.1f} ms "
+              f"({n} steps -> {prog.dur / n * 1e3:.1f} ms/step)")
+        cats = P.op_category_breakdown(
+            td, window=(prog.ts, prog.ts + prog.dur), leaves=True
+        )
+        total = sum(d["seconds"] for d in cats.values())
+        print(f"leaf-covered {total / n * 1e3:.1f} ms/step "
+              f"({total / prog.dur * 100:.1f}% of span; the rest is "
+              "inter-op device gaps)")
+        for cat, d in sorted(cats.items(), key=lambda kv:
+                             -kv[1]["seconds"]):
+            print(f"{cat:10s} {d['seconds'] / n * 1e3:8.2f} ms/step "
+                  f"{d['seconds'] / total * 100:5.1f}%  n={d['count']}")
+            for name, s in d["top"][:3]:
+                print(f"    {name[:70]:70s} {s / n * 1e6:9.1f} us/step")
+
+
+def attribution_candidate():
+    """Leaf attribution of the noremat microbatches=1 candidate."""
+    attribution(remat=False, microbatches=1)
+
+
+def remat_ladder():
+    """Device-trace ms/step for remat variants of the large config —
+    the MFU lever test (full remat vs dots-saveable policy vs none)."""
+    from tpu_p2p.utils import profiling as P
+    from tpu_p2p.utils import timing
+
+    from tpu_p2p.models import flagship as F
+
+    for tag, kw in (
+        ("remat_full", {}),
+        ("remat_dots_policy",
+         {"remat_policy": "dots_with_no_batch_dims_saveable"}),
+        ("noremat", {"remat": False}),
+        ("noremat_mb1", {"remat": False, "microbatches": 1}),
+    ):
+        try:
+            cfg = large_cfg(**kw)
+            mesh = F.build_mesh(1, devices=jax.devices()[:1])
+            # ONE param/token set per variant; make_chain only varies
+            # the scan length (several 0.87 GB param copies at once
+            # would crowd the 16 GB chip).
+            params = F.place_flagship_params(
+                F.init_flagship_params(cfg), mesh, cfg
+            )
+            toks, tgts = F.flagship_token_batch(cfg, mesh)
+            step = F.make_flagship_lm_train_step(mesh, cfg, lr=1e-2)
+
+            def make_chain(k, step=step, toks=toks, tgts=tgts):
+                @jax.jit
+                def chain(p):
+                    def body(pp, _):
+                        p2, loss = step(pp, toks, tgts)
+                        return p2, loss
+
+                    return jax.lax.scan(body, p, None, length=k)
+
+                return chain
+
+            m = P.measure_headline(make_chain, params, 3, repeats=2,
+                                   timing=timing)
+            print(f"{tag}: {m.per_op_s * 1e3:.1f} ms/step "
+                  f"[{m.source}]", flush=True)
+            del params, toks, tgts, step
+        except Exception as e:  # noqa: BLE001
+            print(f"{tag}: FAILED {type(e).__name__}: {str(e)[:140]}",
+                  flush=True)
+
+
+def stall():
+    """Event dump of 1 GiB loopback chains at counts 1 and 8: the r4
+    326 GB/s rung implies ~6.6 ms/iter SLOPE while the in-while rewrite
+    fusion runs at 3.26 ms — so some op outside the while must scale
+    with count. Name it, and print the HLO op inventory to match."""
+    from tpu_p2p.parallel import collectives as C
+    from tpu_p2p.parallel.runtime import make_runtime
+    from tpu_p2p.utils import profiling as P
+
+    rt = make_runtime(num_devices=1)
+    cache = C.CollectiveCache()
+    x = C.make_payload(rt.mesh, 1024 * 1024 * 1024)
+    for count in (1, 8):
+        f = cache.loopback_chain(rt.mesh, count)
+        jax.block_until_ready(f(x))  # compile + warm
+        with tempfile.TemporaryDirectory(prefix="stall_") as td:
+            with jax.profiler.trace(td):
+                jax.block_until_ready(f(x))
+            tops = [t for t in P.device_top_level_events(td)
+                    if t.name.startswith("jit")]
+            tops.sort(key=lambda t: -t.dur)
+            prog = tops[0]
+            print(f"-- count={count}: program span "
+                  f"{prog.dur * 1e3:.2f} ms")
+            xs, pid_names = P.load_trace_events(td)
+            dev_pids = {p for p, n in pid_names.items()
+                        if str(n).startswith("/device:")}
+            evs = [e for e in xs if e["pid"] in dev_pids]
+            evs.sort(key=lambda e: e["ts"])
+            t0us, t1us = prog.ts * 1e6, (prog.ts + prog.dur) * 1e6
+            for e in evs:
+                if not (t0us <= e["ts"] <= t1us):
+                    continue
+                if e["dur"] < 200:  # skip sub-0.2ms noise rows
+                    continue
+                print(f"  t+{(e['ts'] - t0us) / 1e3:9.3f} ms  dur "
+                      f"{e['dur'] / 1e3:8.3f} ms tid={e['tid']:3d} "
+                      f"{e.get('name', '')[:60]}")
+    # HLO inventory of the count=8 chain: which non-while ops exist and
+    # what do they compute? (Names here match the device-track rows.)
+    import re as _re
+
+    txt = cache.loopback_chain(rt.mesh, 8).lower(x).compile().as_text()
+    ops = {}
+    for mm in _re.finditer(r"^\s*(?:ROOT )?%?([a-z_0-9.-]+) = \S+ "
+                           r"([a-z-]+)", txt, _re.M):
+        ops.setdefault(mm.group(2), []).append(mm.group(1))
+    for op, names in sorted(ops.items()):
+        if op in ("parameter", "constant", "get-tuple-element", "tuple"):
+            continue
+        print(f"HLO {op}: {len(names)} ({', '.join(names[:4])})")
+
+
+if __name__ == "__main__":
+    {"attribution": attribution,
+     "attribution_candidate": attribution_candidate,
+     "remat_ladder": remat_ladder,
+     "stall": stall}[sys.argv[1]]()

@@ -162,6 +162,39 @@ def test_loopback_chain_rewrites_buffer(rt):
     np.testing.assert_array_equal(y, (_host(x).astype(np.int32) + 3).astype(np.int8))
 
 
+def test_loopback_payload_preshaped_chain(rt):
+    # The pre-shaped streaming payload (r5: keeps the (1, N) row's
+    # padded layout conversion OUT of the timed chain — the r3/r4
+    # 1 GiB "chain stall" was that relayout splitting the short/long
+    # chains into structurally different programs). Same rank-tagged
+    # values as make_payload, extra (rows, 8192) trailing dims, and
+    # the trailing-aware chain rewrites it identically.
+    cache = C.CollectiveCache()
+    nbytes = 8192 * 4
+    x = C.make_loopback_payload(rt.mesh, nbytes, jnp.int8)
+    n_axes = len(rt.mesh.axis_names)
+    assert x.shape[-2:] == (4, 8192)
+    flat = _host(x).reshape(*_host(x).shape[:n_axes], -1)
+    np.testing.assert_array_equal(
+        flat, C.host_payload(rt.mesh, nbytes, jnp.int8)
+    )
+    y = _host(cache.loopback_chain(rt.mesh, 3, x.ndim - n_axes)(x))
+    np.testing.assert_array_equal(
+        y, (_host(x).astype(np.int32) + 3).astype(np.int8)
+    )
+
+
+def test_loopback_payload_indivisible_falls_back(rt):
+    # 8 B (the latency payload) cannot take the 8192-wide view: the
+    # standard row shape and the default trailing=1 chain still work.
+    x = C.make_loopback_payload(rt.mesh, 8, jnp.int8)
+    assert x.shape == C.make_payload(rt.mesh, 8, jnp.int8).shape
+    y = _host(C.CollectiveCache().loopback_chain(rt.mesh, 2)(x))
+    np.testing.assert_array_equal(
+        y, (_host(x).astype(np.int32) + 2).astype(np.int8)
+    )
+
+
 def test_loopback_chain_non_tile_divisible(rt):
     cache = C.CollectiveCache()
     x = C.make_payload(rt.mesh, 100, jnp.int8)

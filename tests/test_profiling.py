@@ -94,6 +94,39 @@ def test_op_category_breakdown(tmp_path):
     assert set(got2) == {"collective", "copy"}
 
 
+def test_leaf_events_descend_into_while(tmp_path):
+    # A scan-structured step shows depth-1 as one opaque `while` op
+    # (86.9% of the r5 production LM step measured that way); leaf
+    # attribution descends to the innermost ops and still cannot
+    # double-count (no leaf contains another event).
+    events = [
+        _meta(3, "/device:TPU:0"),
+        _ev(3, 1, "jit_step(1)", 100.0, 300.0),    # program (top)
+        _ev(3, 1, "while.9", 110.0, 200.0),        # depth 1: opaque
+        _ev(3, 1, "fusion.1", 120.0, 40.0),        # leaf inside while
+        _ev(3, 1, "copy.2", 170.0, 20.0),          # leaf inside while
+        _ev(3, 1, "custom-call.3", 200.0, 30.0),   # nests a sub-op —
+        _ev(3, 1, "fusion.4", 205.0, 10.0),        # the cc's only leaf
+        _ev(3, 1, "transpose.5", 320.0, 25.0),     # leaf outside while
+        # The program-level mirror track: one childless jit_* span per
+        # execution. Counting it as a leaf would double the total
+        # (measured 200% coverage on the r5 LM-step trace).
+        _ev(3, 2, "jit_step(1)", 100.0, 300.0),
+    ]
+    leaves = P.device_leaf_events(_write_trace(tmp_path, events))
+    assert [v.name for v in leaves] == [
+        "fusion.1", "copy.2", "fusion.4", "transpose.5"
+    ]
+    got = P.op_category_breakdown(_write_trace(tmp_path, events),
+                                  leaves=True)
+    assert "other" not in got          # no opaque while in the totals
+    assert got["fusion"]["seconds"] == pytest.approx(50e-6)
+    assert got["copy"]["seconds"] == pytest.approx(45e-6)  # + transpose
+    # depth-1 view of the same trace: the while dominates as 'other'.
+    got1 = P.op_category_breakdown(_write_trace(tmp_path, events))
+    assert got1["other"]["seconds"] == pytest.approx(200e-6)
+
+
 def test_categorize_op_rules():
     assert P.categorize_op("fusion.12") == "fusion"
     assert P.categorize_op("copy-start.3") == "copy"

@@ -33,6 +33,34 @@ def test_remat_step_matches_plain_step():
                                    atol=1e-5, rtol=1e-5, err_msg=k)
 
 
+def test_remat_policy_matches_full_remat():
+    # Selective remat (save weight-matmul outputs, recompute the
+    # elementwise rest) chooses what is SAVED, not what is computed:
+    # loss and updates must match full-block remat and the plain step.
+    import pytest
+
+    mesh = F.build_mesh(8)
+    cfg = _cfg(use_flash=False, rope=True, remat=True)
+    cfg_p = dataclasses.replace(
+        cfg, remat_policy="dots_with_no_batch_dims_saveable"
+    )
+    params = F.init_flagship_params(cfg)
+    x, t = F.flagship_example_batch(cfg, mesh)
+    placed = F.place_flagship_params(params, mesh)
+    p_a, l_a = F.make_flagship_train_step(mesh, cfg, lr=1e-2)(placed, x, t)
+    p_b, l_b = F.make_flagship_train_step(mesh, cfg_p, lr=1e-2)(placed, x, t)
+    np.testing.assert_allclose(float(l_b), float(l_a), rtol=1e-6)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p_b[k]), np.asarray(p_a[k]),
+                                   atol=1e-5, rtol=1e-5, err_msg=k)
+    # Config validation: typo'd policies and policy-without-remat are
+    # config-time errors, not deep trace failures.
+    with pytest.raises(ValueError, match="remat_policy"):
+        _cfg(remat=True, remat_policy="no_such_policy")
+    with pytest.raises(ValueError, match="requires remat"):
+        _cfg(remat_policy="dots_saveable")
+
+
 def test_remat_composes_with_ring_flash():
     # jax.checkpoint around a block whose attention is the custom-vjp
     # ring flash path (recompute re-runs the ring collectives).

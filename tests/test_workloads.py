@@ -315,11 +315,17 @@ def test_device_mode_in_config_choices():
 def test_device_mode_loopback_records_source(rt, tmp_path):
     """Latency-family cells must also stamp which timeline their
     per-hop estimate came from under --mode device (the serialized
-    p50 keeps its dispatch-inclusive meaning in every mode)."""
+    p50 keeps its dispatch-inclusive meaning in every mode).
+
+    iters=32 (not 8): the differential's long-short delta must clear
+    host-clock noise, and an 8-iter chain at 8 KiB measured a
+    nonpositive slope once under a fully loaded CI box — which
+    correctly publishes source="none", but this test pins the normal
+    host-fallback path, so keep the slope thick enough to resolve."""
     path = str(tmp_path / "cells.jsonl")
     ctx = WorkloadContext(
         rt=rt,
-        cfg=BenchConfig(pattern="loopback", msg_size=8192, iters=8,
+        cfg=BenchConfig(pattern="loopback", msg_size=8192, iters=32,
                         mode="device"),
         jsonl=JsonlWriter(path),
     )

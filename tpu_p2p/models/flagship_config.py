@@ -84,6 +84,15 @@ class FlagshipConfig:
     # O(layers) full-block residuals to O(layers) block inputs, the
     # block recomputes in the bwd — the standard long-sequence
     # FLOPs-for-HBM trade. Gradients are bit-identical either way.
+    remat_policy: str = ""   # with remat=True: name of a
+    # jax.checkpoint_policies policy for SELECTIVE rematerialization
+    # ("" = save block inputs only, recompute everything — the classic
+    # full-block remat). "dots_with_no_batch_dims_saveable" saves
+    # weight-matmul outputs (projections, FFN) and recomputes only the
+    # cheap elementwise/norm work in the backward — most of remat's
+    # memory saving at a fraction of its recompute FLOPs. Gradients
+    # are bit-identical under any policy (policies choose what is
+    # saved, not what is computed).
     attn_window: int = 0     # > 0: sliding-window (local) attention —
     # each position attends to its last `attn_window` positions. Needs
     # causal=True; works under every sp_strategy (ring paths window
@@ -106,6 +115,16 @@ class FlagshipConfig:
             )
         if self.attn_window and not self.causal:
             raise ValueError("attn_window requires causal=True")
+        # Strict: a typo'd policy name must fail at config time, not
+        # trace deep inside the step builder.
+        if self.remat_policy and not hasattr(jax.checkpoint_policies,
+                                             self.remat_policy):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r}; expected "
+                "a jax.checkpoint_policies name"
+            )
+        if self.remat_policy and not self.remat:
+            raise ValueError("remat_policy requires remat=True")
 
     @property
     def model_dim(self) -> int:

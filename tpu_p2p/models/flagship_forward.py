@@ -128,9 +128,14 @@ def _stage_block(stage_params: Params, x, cfg: FlagshipConfig,
 
     body = cast_and_run
     if cfg.remat:
-        # Per-block rematerialization: save only each block's input,
-        # recompute the block inside the backward.
-        body = jax.checkpoint(cast_and_run, static_argnums=(2, 3, 4, 5))
+        # Per-block rematerialization: save only each block's input
+        # (plus whatever cfg.remat_policy marks saveable — e.g. weight
+        # matmul outputs under dots_with_no_batch_dims_saveable),
+        # recompute the rest inside the backward.
+        policy = (getattr(jax.checkpoint_policies, cfg.remat_policy)
+                  if cfg.remat_policy else None)
+        body = jax.checkpoint(cast_and_run, static_argnums=(2, 3, 4, 5),
+                              policy=policy)
     for i in range(s_local):
         sub = {k: v[i] for k, v in stage_params.items()}
         x = body(sub, x, cfg, sp, tp, ep)

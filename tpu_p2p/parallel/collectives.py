@@ -112,6 +112,28 @@ def make_payload(mesh: Mesh, msg_bytes: int, dtype=jnp.int8) -> jax.Array:
     return jax.device_put(host, payload_sharding(mesh))
 
 
+def make_loopback_payload(mesh: Mesh, msg_bytes: int,
+                          dtype=jnp.int8) -> jax.Array:
+    """:func:`make_payload`, pre-shaped to the loopback chain's
+    (rows, 8192) streaming view when the element count divides.
+
+    The (1, elems) per-device row carries TPU's padded 1-row int8
+    layout; reshaping it INSIDE the chain program puts a full layout
+    conversion (and, at short counts, the whole rewrite) on the bad
+    layout — see :meth:`CollectiveCache.loopback_chain` for the
+    measured damage. Pre-shaping moves the one-time view change to
+    this untimed ``device_put``. Indivisible sizes (the 8 B latency
+    payload) fall back to the standard row shape.
+    """
+    elems = elems_for(msg_bytes, dtype)
+    host = _payload_np(mesh.devices.shape, elems, dtype)
+    if elems % 8192:
+        return jax.device_put(host, payload_sharding(mesh))
+    host = host.reshape(*host.shape[:-1], elems // 8192, 8192)
+    spec = P(*mesh.axis_names, None, None)
+    return jax.device_put(host, NamedSharding(mesh, spec))
+
+
 def expected_permute(x: np.ndarray, edges: Sequence[Edge], axis: int = 0) -> np.ndarray:
     """Reference semantics of one ``ppermute`` application on the host.
 
@@ -221,7 +243,7 @@ class CollectiveCache:
 
         return self._get(key, build)
 
-    def loopback_chain(self, mesh: Mesh, count: int):
+    def loopback_chain(self, mesh: Mesh, count: int, trailing: int = 1):
         """``count`` chained whole-buffer rewrites on each device.
 
         The loopback config (BASELINE configs[0]) degenerates on a
@@ -230,16 +252,33 @@ class CollectiveCache:
         per-hop ``x + 1`` cannot be elided and streams the full buffer
         through HBM once per hop — the honest on-device analogue of a
         loopback transfer (read ``msg`` + write ``msg`` per hop).
+
+        ``trailing``: number of per-device payload dims (1 for the
+        standard ``make_payload`` row, 2 for the pre-shaped
+        :func:`make_loopback_payload` streaming view). Pass payloads
+        through :func:`make_loopback_payload` for chain measurements:
+        reshaping the (1, N) row inside the chain forced the padded
+        1-row layout through the program boundary — the r5 trace of
+        the 1 GiB rung shows 33 ms of relayout ops (reduce 19.4 +
+        reshape 4.0 + copy 9.7) around the while loop at count=8
+        while count=1 compiles to ONE fusion on the bad layout at
+        3.9x the per-rewrite time, so the two chain lengths were
+        structurally different programs and the differential's
+        constant-cost cancellation silently broke (the r3/r4 ladder's
+        "hbm_chain_stall" rung, bench 326 vs 657 GB/s).
         """
-        key = ("loopback", mesh, count)
+        key = ("loopback", mesh, count, trailing)
 
         def build():
-            spec = P(*mesh.axis_names, None)
+            spec = P(*mesh.axis_names, *([None] * trailing))
 
             def f(x):
                 # The payload's local block is (1, ..., elems); int8
                 # tiling pads a 1-row shape badly (measured 3.9x slower
                 # per rewrite), so stream through a (rows, 8192) view.
+                # With a pre-shaped payload this reshape is a free
+                # leading-1 collapse, and the compiled program is the
+                # while loop alone at every count.
                 shape = x.shape
                 y = x.reshape(-1, 8192) if x.size % 8192 == 0 else x
 
