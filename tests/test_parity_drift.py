@@ -23,23 +23,32 @@ import pytest
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 # (label, regex over PARITY.md, key into the artifact's detail dict,
-#  relative tolerance). Tolerances: device-trace TF/s slopes repeat
-# within a few percent across rounds (r3 5.96 vs r4 6.01 ms — ~1%);
-# 10% catches every real change (the r4 miss was 20%). The sub-µs
-# latency floors are the jitteriest fields — 30%.
+#  relative tolerance, scale: quoted*scale is compared to the artifact
+#  value). Tolerances: device-trace TF/s slopes repeat within a few
+# percent across rounds (r3 5.96 vs r4 6.01 ms — ~1%); 10% catches
+# every real change (the r4 miss was 20%). The sub-µs latency floors
+# are the jitteriest fields — 30%.
 QUOTES = (
     ("flash fwd TFLOP/s",
      r"(\d+(?:\.\d+)?) TFLOP/s causal fwd",
-     "flash_attention_tflops", 0.10),
+     "flash_attention_tflops", 0.10, 1.0),
     ("flash fwd+bwd TF/s",
      r"fwd\+bwd (\d+(?:\.\d+)?) TF/s conventional",
-     "flash_bwd_tflops", 0.10),
+     "flash_bwd_tflops", 0.10, 1.0),
     ("8B scan-floor latency µs",
      r"p50 scan floor (\d+(?:\.\d+)?) µs",
-     "latency_8b_p50_us", 0.30),
+     "latency_8b_p50_us", 0.30, 1.0),
     ("8B one-op span µs",
      r"one-op program span (\d+(?:\.\d+)?) µs",
-     "latency_8b_oneop_p50_us", 0.30),
+     "latency_8b_oneop_p50_us", 0.30, 1.0),
+    # Round-5 production-shape LM headline. The artifact stores MFU as
+    # a fraction (0.71); PARITY quotes a percentage.
+    ("production LM step ms",
+     r"(\d+(?:\.\d+)?) ms/step, \d", "flagship_large_step_ms",
+     0.10, 1.0),
+    ("production LM MFU %",
+     r"MFU (\d+(?:\.\d+)?)% production", "flagship_large_mfu",
+     0.10, 0.01),
 )
 
 
@@ -66,7 +75,7 @@ def test_parity_perf_rows_match_newest_bench_artifact():
     with open(os.path.join(REPO, "PARITY.md")) as fh:
         text = fh.read()
     problems = []
-    for label, pattern, key, tol in QUOTES:
+    for label, pattern, key, tol, scale in QUOTES:
         m = re.search(pattern, text)
         if not m:
             problems.append(
@@ -75,7 +84,7 @@ def test_parity_perf_rows_match_newest_bench_artifact():
                 "with the doc"
             )
             continue
-        quoted = float(m.group(1))
+        quoted = float(m.group(1)) * scale
         actual = detail.get(key)
         if actual is None:
             # That round's measurement failed/was skipped: a null
