@@ -30,7 +30,11 @@ def large_cfg(**kw):
     return F.FlagshipConfig(**base)
 
 
-def _step_chain(cfg, n):
+def _step_chain_factory(cfg):
+    """ONE construction of the measured program for every probe:
+    (make_chain, params) where make_chain(n) jits a scan of n train
+    steps. The ladder and the attribution must measure the same
+    program, so they must share this."""
     from tpu_p2p.models import flagship as F
 
     mesh = F.build_mesh(1, devices=jax.devices()[:1])
@@ -39,15 +43,23 @@ def _step_chain(cfg, n):
     toks, tgts = F.flagship_token_batch(cfg, mesh)
     step = F.make_flagship_lm_train_step(mesh, cfg, lr=1e-2)
 
-    @jax.jit
-    def chain(p):
-        def body(pp, _):
-            p2, loss = step(pp, toks, tgts)
-            return p2, loss
+    def make_chain(n):
+        @jax.jit
+        def chain(p):
+            def body(pp, _):
+                p2, loss = step(pp, toks, tgts)
+                return p2, loss
 
-        return jax.lax.scan(body, p, None, length=n)
+            return jax.lax.scan(body, p, None, length=n)
 
-    return chain, params
+        return chain
+
+    return make_chain, params
+
+
+def _step_chain(cfg, n):
+    make_chain, params = _step_chain_factory(cfg)
+    return make_chain(n), params
 
 
 def attribution(**cfg_kw):
@@ -95,8 +107,6 @@ def remat_ladder():
     from tpu_p2p.utils import profiling as P
     from tpu_p2p.utils import timing
 
-    from tpu_p2p.models import flagship as F
-
     for tag, kw in (
         ("remat_full", {}),
         ("remat_dots_policy",
@@ -105,33 +115,15 @@ def remat_ladder():
         ("noremat_mb1", {"remat": False, "microbatches": 1}),
     ):
         try:
-            cfg = large_cfg(**kw)
-            mesh = F.build_mesh(1, devices=jax.devices()[:1])
-            # ONE param/token set per variant; make_chain only varies
-            # the scan length (several 0.87 GB param copies at once
-            # would crowd the 16 GB chip).
-            params = F.place_flagship_params(
-                F.init_flagship_params(cfg), mesh, cfg
-            )
-            toks, tgts = F.flagship_token_batch(cfg, mesh)
-            step = F.make_flagship_lm_train_step(mesh, cfg, lr=1e-2)
-
-            def make_chain(k, step=step, toks=toks, tgts=tgts):
-                @jax.jit
-                def chain(p):
-                    def body(pp, _):
-                        p2, loss = step(pp, toks, tgts)
-                        return p2, loss
-
-                    return jax.lax.scan(body, p, None, length=k)
-
-                return chain
-
+            # ONE param/token set per variant (inside the factory);
+            # make_chain only varies the scan length (several 0.87 GB
+            # param copies at once would crowd the 16 GB chip).
+            make_chain, params = _step_chain_factory(large_cfg(**kw))
             m = P.measure_headline(make_chain, params, 3, repeats=2,
                                    timing=timing)
             print(f"{tag}: {m.per_op_s * 1e3:.1f} ms/step "
                   f"[{m.source}]", flush=True)
-            del params, toks, tgts, step
+            del make_chain, params
         except Exception as e:  # noqa: BLE001
             print(f"{tag}: FAILED {type(e).__name__}: {str(e)[:140]}",
                   flush=True)

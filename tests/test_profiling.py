@@ -112,6 +112,12 @@ def test_leaf_events_descend_into_while(tmp_path):
         # execution. Counting it as a leaf would double the total
         # (measured 200% coverage on the r5 LM-step trace).
         _ev(3, 2, "jit_step(1)", 100.0, 300.0),
+        # Async DMA transfer rows ride their own device thread at
+        # depth 0 (childless, not jit-named). They are not program ops
+        # — depth-1 attribution never saw them, and counting them as
+        # leaves would inflate the copy share past 100% coverage.
+        _ev(3, 4, "copy-start.7", 130.0, 50.0),
+        _ev(3, 4, "copy-done.7", 260.0, 5.0),
     ]
     leaves = P.device_leaf_events(_write_trace(tmp_path, events))
     assert [v.name for v in leaves] == [

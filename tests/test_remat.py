@@ -59,6 +59,13 @@ def test_remat_policy_matches_full_remat():
         _cfg(remat=True, remat_policy="no_such_policy")
     with pytest.raises(ValueError, match="requires remat"):
         _cfg(remat_policy="dots_saveable")
+    # jax.checkpoint_policies FACTORY names pass hasattr but are not
+    # policies — passed through they crash mid-trace or silently save
+    # everything. They must be config-time errors too.
+    for factory in ("save_only_these_names", "save_from_both_policies",
+                    "save_any_names_but_these"):
+        with pytest.raises(ValueError, match="remat_policy"):
+            _cfg(remat=True, remat_policy=factory)
 
 
 def test_remat_composes_with_ring_flash():

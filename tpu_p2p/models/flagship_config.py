@@ -116,13 +116,32 @@ class FlagshipConfig:
         if self.attn_window and not self.causal:
             raise ValueError("attn_window requires causal=True")
         # Strict: a typo'd policy name must fail at config time, not
-        # trace deep inside the step builder.
-        if self.remat_policy and not hasattr(jax.checkpoint_policies,
-                                             self.remat_policy):
-            raise ValueError(
-                f"unknown remat_policy {self.remat_policy!r}; expected "
-                "a jax.checkpoint_policies name"
-            )
+        # trace deep inside the step builder. hasattr alone is not
+        # enough — jax.checkpoint_policies also exposes FACTORIES
+        # (save_only_these_names, save_from_both_policies, ...) that
+        # take configuration args and RETURN a policy; passed directly
+        # to jax.checkpoint they either crash mid-trace or silently
+        # save everything. A real policy maps (prim, *args, **params)
+        # to a save decision, so probe-call with a primitive: factories
+        # return a callable (or reject the argument), policies return a
+        # non-callable decision value.
+        if self.remat_policy:
+            pol = getattr(jax.checkpoint_policies, self.remat_policy,
+                          None)
+            usable = callable(pol)
+            if usable:
+                try:
+                    usable = not callable(pol(jax.lax.add_p))
+                except TypeError:
+                    usable = False
+            if not usable:
+                raise ValueError(
+                    f"unknown remat_policy {self.remat_policy!r}; "
+                    "expected the name of a jax.checkpoint_policies "
+                    "POLICY (e.g. 'dots_with_no_batch_dims_saveable')"
+                    " — factory names that build policies from "
+                    "arguments are not accepted"
+                )
         if self.remat_policy and not self.remat:
             raise ValueError("remat_policy requires remat=True")
 

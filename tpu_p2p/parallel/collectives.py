@@ -126,9 +126,9 @@ def make_loopback_payload(mesh: Mesh, msg_bytes: int,
     payload) fall back to the standard row shape.
     """
     elems = elems_for(msg_bytes, dtype)
-    host = _payload_np(mesh.devices.shape, elems, dtype)
     if elems % 8192:
-        return jax.device_put(host, payload_sharding(mesh))
+        return make_payload(mesh, msg_bytes, dtype)
+    host = _payload_np(mesh.devices.shape, elems, dtype)
     host = host.reshape(*host.shape[:-1], elems // 8192, 8192)
     spec = P(*mesh.axis_names, None, None)
     return jax.device_put(host, NamedSharding(mesh, spec))

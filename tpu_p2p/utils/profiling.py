@@ -212,15 +212,15 @@ def device_leaf_events(trace_dir: str) -> List[DeviceEvent]:
         def flush_until(ts):
             while stack and ts >= stack[-1][0]:
                 end, ev, had_child, depth = stack.pop()
-                # Childless program-wrapper events are not leaves:
-                # the device track mirrors each program on a second
-                # (program-level) tid with no op children — counting
-                # that jit_* span alongside the op tid's real leaves
-                # would double the total (measured 200% coverage on
-                # the r5 LM-step trace).
-                if not had_child and not (
-                    depth == 0 and str(ev.get("name", "")).startswith("jit")
-                ):
+                # A leaf must be NESTED (depth >= 1): real op rows
+                # always sit inside their program's jit_* span on the
+                # op tid. Childless depth-0 rows are never ops — the
+                # program-mirror tid's jit_* span (counting it doubled
+                # the total: measured 200% coverage on the r5 LM-step
+                # trace), the second thread's top-level op-row copies,
+                # and async copy-start/copy-done transfer rows — all
+                # of which depth-1 attribution also excludes.
+                if not had_child and depth > 0:
                     out.append(DeviceEvent(
                         name=ev.get("name", ""), ts=ev["ts"] / 1e6,
                         dur=ev["dur"] / 1e6, pid=pid, tid=tid,
