@@ -261,22 +261,37 @@ def test_isolation_modes_agree_on_verification(rt, tmp_path):
 def test_device_mode_ring_falls_back_to_host_on_cpu(rt, tmp_path, capsys):
     """--mode device: the cell value is the device-timeline slope; on
     the CPU test mesh (no device track) it falls back to the host slope
-    and the cell record says which source it published."""
-    path = str(tmp_path / "cells.jsonl")
-    ctx = WorkloadContext(
-        rt=rt,
-        cfg=BenchConfig(pattern="ring", msg_size=4096, iters=16,
-                        mode="device"),
-        jsonl=JsonlWriter(path),
-    )
-    out = run_ring(ctx)
-    ctx.jsonl.close()
-    assert out[0]["gbps_per_device"] > 0
-    assert "ring" in capsys.readouterr().out
-    rec = json.loads(open(path).read().splitlines()[0])
-    assert rec["mode"] == "device"
-    # CellRecord.to_json flattens extra into the top level.
-    assert rec["source"] == "host_differential"
+    and the cell record says which source it published.
+
+    The subject is the fallback WIRING, not host-timer robustness: on
+    a loaded single-core box the 16-iter differential slope can come
+    out non-positive from scheduler noise (the production NaN-not-lie
+    policy then correctly publishes NaN), so a noise-hit attempt is
+    retried rather than failed — the wiring assertions still run on
+    every attempt's record.
+    """
+    for attempt in range(3):
+        path = str(tmp_path / f"cells_{attempt}.jsonl")
+        ctx = WorkloadContext(
+            rt=rt,
+            cfg=BenchConfig(pattern="ring", msg_size=4096, iters=16,
+                            mode="device"),
+            jsonl=JsonlWriter(path),
+        )
+        out = run_ring(ctx)
+        ctx.jsonl.close()
+        assert "ring" in capsys.readouterr().out
+        rec = json.loads(open(path).read().splitlines()[0])
+        assert rec["mode"] == "device"
+        # CellRecord.to_json flattens extra into the top level.
+        assert rec["source"] == "host_differential"
+        if out[0]["gbps_per_device"] > 0:
+            break
+    else:
+        raise AssertionError(
+            "host-slope fallback produced a non-positive slope on all "
+            f"3 attempts (last cell: {out[0]!r})"
+        )
 
 
 def test_device_mode_publishes_device_slope(rt, monkeypatch):
