@@ -129,6 +129,82 @@ def remat_ladder():
                   flush=True)
 
 
+def flash_ladder_large():
+    """Block ladder at the production LM attention shape (B4, H16,
+    GQA kv8, T=4096, D=128, causal, bf16). The LM-step leaf
+    attribution has the flash kernels at ~60% of peak here vs 65% at
+    the T=16k bench shape — confirm the (1024, 1024) default is still
+    the optimum at this shorter sweep, or take the lever. Flop
+    accounting matches bench: causal fwd = 2*b*h*t^2*d, fwd+bwd 3.5x.
+    """
+    import numpy as np
+
+    from tpu_p2p.ops import flash_attention as FA
+    from tpu_p2p.utils import profiling as P
+    from tpu_p2p.utils import timing
+
+    b, h, hkv, t, d = 4, 16, 8, 4096, 128
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, hkv, t, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, hkv, t, d)), jnp.bfloat16)
+    # Grad w.r.t. ALL inputs (q-only lets XLA DCE the dkdv work); the
+    # narrow GQA dk/dv fold into the carry as scalars so shapes match.
+    grad = jax.grad(
+        lambda qq, kk, vv: FA.flash_attention(qq, kk, vv, True)
+        .astype(jnp.float32).sum(), argnums=(0, 1, 2),
+    )
+    base = b * h * t * t * d  # one causal-halved t x t x d matmul
+    orig = FA._default_blocks
+    try:
+        for bq, bk in ((1024, 1024), (2048, 1024), (1024, 2048),
+                       (512, 1024), (1024, 512), (512, 512)):
+            FA._default_blocks = (
+                lambda tq, tk, dd, _bq=bq, _bk=bk:
+                (min(_bq, tq), min(_bk, tk))
+            )
+
+            def make_fwd(n):
+                @jax.jit
+                def f(c):
+                    def step(cc, _):
+                        return FA.flash_attention(cc, k, v, True), None
+
+                    return jax.lax.scan(step, c, None, length=n)[0]
+
+                return f
+
+            def make_fb(n):
+                @jax.jit
+                def f(c):
+                    def step(cc, _):
+                        dq, dk, dv = grad(cc, k, v)
+                        bleed = (dk.astype(jnp.float32).sum()
+                                 + dv.astype(jnp.float32).sum())
+                        return (dq + bleed.astype(cc.dtype)), None
+
+                    return jax.lax.scan(step, c, None, length=n)[0]
+
+                return f
+
+            for tag, mk, mult, iters in (
+                ("fwd", make_fwd, 2.0, 16), ("fwd+bwd", make_fb, 7.0, 8)
+            ):
+                try:
+                    m = P.measure_headline(mk, q, iters, repeats=3,
+                                           timing=timing)
+                    tf = mult * base / m.per_op_s / 1e12
+                    print(f"({bq},{bk}) {tag}: "
+                          f"{m.per_op_s * 1e6:8.1f} us/call "
+                          f"{tf:6.1f} TF/s [{m.source}]", flush=True)
+                except Exception as e:  # noqa: BLE001
+                    print(f"({bq},{bk}) {tag}: FAILED "
+                          f"{type(e).__name__}: {str(e)[:120]}",
+                          flush=True)
+    finally:
+        FA._default_blocks = orig
+
+
 def stall():
     """Event dump of 1 GiB loopback chains at counts 1 and 8: the r4
     326 GB/s rung implies ~6.6 ms/iter SLOPE while the in-while rewrite
@@ -186,4 +262,5 @@ if __name__ == "__main__":
     {"attribution": attribution,
      "attribution_candidate": attribution_candidate,
      "remat_ladder": remat_ladder,
+     "flash_ladder_large": flash_ladder_large,
      "stall": stall}[sys.argv[1]]()
