@@ -46,6 +46,75 @@ import sys
 
 NVLINK_A100_GBPS = 1600.0  # ~200 GB/s busbw class, BASELINE.md anchor
 
+# ---------------------------------------------------------------------
+# Artifact machine contract (round 6): the driver keeps only the LAST
+# ~2000 bytes of stdout and parses the final line as JSON. The full
+# detail dict outgrew that window in round 5 (BENCH_r05.json:
+# ``parsed: null`` — the line was truncated mid-JSON), so the final
+# line is now a COMPACT headline (≤ COMPACT_LINE_MAX_BYTES) and the
+# full result is persisted to BENCH_detail.json next to this file
+# (override with $BENCH_DETAIL_PATH; tests point it at a tmp dir).
+# HEADLINE_KEYS is ordered most-important-first — when the line would
+# overflow, entries drop from the END until it fits, so the graded
+# numbers (and every key the PARITY drift guard checks) survive.
+
+BENCH_DETAIL_FILENAME = "BENCH_detail.json"
+COMPACT_LINE_MAX_BYTES = 1024
+
+HEADLINE_KEYS = (
+    "devices",
+    "headline_source",
+    "hbm_gbytes_per_s",
+    "flash_attention_tflops",
+    "flash_bwd_tflops",
+    "flagship_large_step_ms",
+    "flagship_large_mfu",
+    "latency_8b_p50_us",
+    "latency_8b_oneop_p50_us",
+    "fsdp_overlap_frac",
+    "fsdp_step_ms_overlap_none",
+    "fsdp_step_ms_overlap_prefetch",
+    "flagship_step_ms",
+    "decode_ms_per_token",
+    "decode_hbm_ms_per_token",
+    "flagship_large_tokens_per_s",
+    "pairs_measured",
+    "min_gbps",
+    "max_gbps",
+)
+
+
+def _detail_path() -> str:
+    import os
+
+    env = os.environ.get("BENCH_DETAIL_PATH")
+    if env:
+        return env
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        BENCH_DETAIL_FILENAME)
+
+
+def _compact_line(result: dict, detail_file) -> str:
+    """The final-stdout-line JSON: n, headline numbers, sources —
+    guaranteed ≤ COMPACT_LINE_MAX_BYTES (least-important headline
+    entries are dropped first if a future round bloats a value)."""
+    d = result.get("detail", {})
+    head = {k: d[k] for k in HEADLINE_KEYS if d.get(k) is not None}
+    line = {
+        "metric": result.get("metric"),
+        "value": result.get("value"),
+        "unit": result.get("unit"),
+        "vs_baseline": result.get("vs_baseline"),
+        "n": d.get("devices"),
+        "headline": head,
+        "detail_file": detail_file,
+    }
+    s = json.dumps(line, separators=(",", ":"))
+    while len(s.encode("utf-8")) > COMPACT_LINE_MAX_BYTES and head:
+        head.pop(next(reversed(head)))
+        s = json.dumps(line, separators=(",", ":"))
+    return s
+
 # Per-generation bf16 MXU peak TFLOP/s (public spec numbers), matched
 # like HBM_PEAKS_GBYTES_PER_S below: the MFU denominator must be the
 # chip's OWN peak, or the fraction lies across generations.
@@ -380,6 +449,110 @@ def _flagship_large_metrics(timing, mxu_peak_tflops):
         "flagship_large_params_m": round(n_params / 1e6, 1),
         "flagship_large_source": m.source,
     }
+
+
+# Null shape of _fsdp_overlap_metrics — failure must produce the same
+# keys (schema stability, like the other model metrics).
+FSDP_NULL = {
+    "fsdp_devices": None,
+    "fsdp_step_ms_overlap_none": None,
+    "fsdp_step_ms_overlap_prefetch": None,
+    "fsdp_overlap_frac": None,
+    "fsdp_gather_ms": None,
+    "fsdp_source": None,
+}
+
+
+def _fsdp_overlap_metrics(timing):
+    """FSDP double-buffered prefetch (round 6 tentpole): the flagship
+    ZeRO-3 step under ``overlap="none"`` vs ``overlap="prefetch"`` on
+    a pure-dp mesh over every visible device, plus the device-trace
+    overlap fraction — the share of all-gather time hidden under
+    concurrent compute (:func:`tpu_p2p.utils.profiling.
+    gather_overlap_fraction`).
+
+    On a single chip dp=1, the ZeRO plan is empty and the prefetch
+    path must degrade to the byte-identical baseline — equal step
+    times are the pass criterion there, and ``fsdp_overlap_frac`` is
+    null (no gather exists to hide). On a multi-device mesh the two
+    step times are the before/after for the explicit schedule and the
+    fraction should be > 0 on hardware with a device track.
+    """
+    import functools
+    import math
+    import tempfile
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.utils.profiling import gather_overlap_fraction
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.asarray(devs).reshape(n), ("dp",))
+    out = dict(FSDP_NULL)
+    out["fsdp_devices"] = n
+    losses = {}
+    for mode in ("none", "prefetch"):
+        cfg = F.FlagshipConfig(
+            batch=2 * n, seq=128, heads=8, head_dim=32, stages=4,
+            microbatches=1, dense_ffn=True, moe_mult=2,
+            dtype="float32", zero_dp=True, overlap=mode,
+        )
+        params = F.place_flagship_params(
+            F.init_flagship_params(cfg), mesh, cfg
+        )
+        x, t = F.flagship_example_batch(cfg, mesh)
+        step = F.make_flagship_train_step(mesh, cfg, lr=1e-2)
+        losses[mode] = float(step(params, x, t)[1])
+        if not math.isfinite(losses[mode]):
+            raise RuntimeError(f"fsdp overlap={mode} loss non-finite")
+
+        @functools.lru_cache(maxsize=None)
+        def make_chain(k, step=step, x=x, t=t):
+            @jax.jit
+            def f(p):
+                def body(p, _):
+                    p2, loss = step(p, x, t)
+                    return p2, loss
+
+                return jax.lax.scan(body, p, None, length=k)[1]
+
+            return f
+
+        m = _measure(timing, make_chain, params, 8, repeats=2)
+        if m.per_op_s is None:
+            raise RuntimeError(
+                f"fsdp overlap={mode} slope was not positive"
+            )
+        out[f"fsdp_step_ms_overlap_{mode}"] = round(m.per_op_s * 1e3, 3)
+        out["fsdp_source"] = m.source
+        if mode == "prefetch":
+            # One traced step for the overlap fraction (null on
+            # platforms recording no device track).
+            with tempfile.TemporaryDirectory(prefix="fsdp_ov_") as td:
+                with jax.profiler.trace(td):
+                    jax.block_until_ready(step(params, x, t))
+                ov = gather_overlap_fraction(td)
+            if ov is not None:
+                out["fsdp_overlap_frac"] = (
+                    round(ov["frac"], 4) if ov["frac"] is not None
+                    else None
+                )
+                out["fsdp_gather_ms"] = round(ov["gather_s"] * 1e3, 4)
+    # Numerical honesty: the two schedules compute the same math; a
+    # real divergence means the prefetch path is broken and its step
+    # time must not publish (parity is also pinned structurally in
+    # tests/test_fsdp.py).
+    ref = abs(losses["none"]) or 1.0
+    if abs(losses["none"] - losses["prefetch"]) > 0.05 * ref:
+        raise RuntimeError(
+            f"fsdp overlap loss divergence: none={losses['none']} "
+            f"prefetch={losses['prefetch']}"
+        )
+    return out
 
 
 def _decode_chain_slope(timing, max_len: int, iters: int = 512,
@@ -1160,7 +1333,27 @@ def main() -> int:
                 ),
             },
         }
-    print(json.dumps(result))
+    # FSDP prefetch metrics (round-6 tentpole) run in BOTH branches —
+    # dp spans every visible device; a 1-chip mesh measures the
+    # degrade-to-baseline contract. Guarded like every model metric.
+    try:
+        fsdp_m = _fsdp_overlap_metrics(timing)
+    except Exception as e:  # noqa: BLE001 — same rationale
+        print(f"# fsdp overlap measurement failed: {e!r}", file=sys.stderr)
+        fsdp_m = {}
+    result["detail"].update({k: fsdp_m.get(k) for k in FSDP_NULL})
+
+    detail_path = _detail_path()
+    try:
+        with open(detail_path, "w") as fh:
+            json.dump(result, fh, indent=1)
+            fh.write("\n")
+    except OSError as e:
+        print(f"# could not write {detail_path}: {e!r}", file=sys.stderr)
+        detail_path = None
+    print(_compact_line(
+        result, os.path.basename(detail_path) if detail_path else None
+    ))
     return 0
 
 

@@ -84,10 +84,19 @@ def attribution(**cfg_kw):
         cats = P.op_category_breakdown(
             td, window=(prog.ts, prog.ts + prog.dur), leaves=True
         )
+        # The reserved dropped_unnested entry is NOT leaf time (it is
+        # the program-mirror span + async transfer rows the leaf view
+        # excludes); summing it would read as >100% span coverage.
+        dropped = cats.pop("dropped_unnested", None)
         total = sum(d["seconds"] for d in cats.values())
         print(f"leaf-covered {total / n * 1e3:.1f} ms/step "
               f"({total / prog.dur * 100:.1f}% of span; the rest is "
               "inter-op device gaps)")
+        if dropped:
+            print(f"(+ {dropped['seconds'] / n * 1e3:.2f} ms/step of "
+                  f"childless depth-0 rows excluded, n="
+                  f"{dropped['count']} — mirror spans/async transfers "
+                  "on a conforming trace)")
         for cat, d in sorted(cats.items(), key=lambda kv:
                              -kv[1]["seconds"]):
             print(f"{cat:10s} {d['seconds'] / n * 1e3:8.2f} ms/step "
@@ -156,13 +165,22 @@ def flash_ladder_large():
     )
     base = b * h * t * t * d  # one causal-halved t x t x d matmul
     orig = FA._default_blocks
+    orig_bwd = FA._bwd_blocks
     try:
         for bq, bk in ((1024, 1024), (2048, 1024), (1024, 2048),
                        (512, 1024), (1024, 512), (512, 512)):
-            FA._default_blocks = (
+            patched = (
                 lambda tq, tk, dd, _bq=bq, _bk=bk:
                 (min(_bq, tq), min(_bk, tk))
             )
+            # BOTH aliases: _bwd_blocks is bound to _default_blocks at
+            # import time (`_bwd_blocks = _default_blocks`), so
+            # patching only the forward name leaves the backward
+            # kernels on the import-time default — the r5 ladder's
+            # fwd+bwd rows actually varied only the FORWARD tiles and
+            # were mislabeled (docs/flash_ceiling.md r6 note).
+            FA._default_blocks = patched
+            FA._bwd_blocks = patched
 
             def make_fwd(n):
                 @jax.jit
@@ -203,6 +221,7 @@ def flash_ladder_large():
                           flush=True)
     finally:
         FA._default_blocks = orig
+        FA._bwd_blocks = orig_bwd
 
 
 def stall():

@@ -245,3 +245,104 @@ def test_duplicate_source_rejected(rt, cache):
 
     with pytest.raises(ValueError, match="duplicate source"):
         cache.permute(rt.mesh, "d", [(2, 6), (2, 0)])
+
+
+# ------------------------------------------------- bucketed all-gather
+
+
+def test_bucketed_all_gather_matches_per_leaf_gathers(rt):
+    """The FSDP prefetch transport: one flattened collective per
+    dtype-bucket must reproduce the per-leaf tiled all_gather
+    bit-for-bit, across gather dims, dtypes, and bucket splits."""
+    import jax
+
+    from jax.sharding import PartitionSpec as P
+
+    mesh = rt.mesh
+
+    def f(a, b, c):
+        got = C.bucketed_all_gather(
+            {"a": (a, 0), "b": (b, 1), "c": (c, 0)}, "d")
+        wa = jax.lax.all_gather(a, "d", axis=0, tiled=True)
+        wb = jax.lax.all_gather(b, "d", axis=1, tiled=True)
+        wc = jax.lax.all_gather(c, "d", axis=0, tiled=True)
+        d1 = jnp.abs(got["a"] - wa).max() + jnp.abs(got["b"] - wb).max()
+        d2 = jnp.abs(got["c"].astype(jnp.float32)
+                     - wc.astype(jnp.float32)).max()
+        # A tiny bucket_bytes cap splits into several collectives —
+        # values must not change.
+        got2 = C.bucketed_all_gather({"a": (a, 0), "b": (b, 1)}, "d",
+                                     bucket_bytes=8)
+        d3 = (jnp.abs(got2["a"] - wa).max()
+              + jnp.abs(got2["b"] - wb).max())
+        return (d1 + d2 + d3).reshape(1)
+
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((16, 3)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((5, 24)), jnp.float32)
+    c = jnp.asarray(rng.standard_normal((8, 4)), jnp.bfloat16)
+    sm = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P("d", None), P(None, "d"), P("d", None)),
+        out_specs=P("d"),
+    )
+    out = np.asarray(jax.jit(sm)(a, b, c))
+    assert np.all(out == 0.0), out
+
+
+def test_bucketed_all_gather_rejects_bad_dim(rt):
+    import jax
+
+    from jax.sharding import PartitionSpec as P
+
+    def f(a):
+        return C.bucketed_all_gather({"a": (a, 2)}, "d")["a"]
+
+    sm = jax.shard_map(f, mesh=rt.mesh, in_specs=P("d", None),
+                       out_specs=P("d", None))
+    with pytest.raises(ValueError, match="out of range"):
+        jax.jit(sm)(jnp.zeros((8, 4)))
+
+
+def test_gather_buckets_split_by_bytes():
+    class Fake:
+        def __init__(self, nbytes):
+            self.size = nbytes
+            self.dtype = np.dtype(np.int8)
+
+    items = [("a", Fake(10), 0), ("b", Fake(10), 0), ("c", Fake(30), 0),
+             ("d", Fake(5), 0)]
+    # None: one bucket.
+    assert C._gather_buckets(items, None) == [items]
+    got = C._gather_buckets(items, 20)
+    assert [[k for k, *_ in b] for b in got] == [["a", "b"], ["c"], ["d"]]
+
+
+def test_bucketed_ag_chain_matches_host_oracle(rt, cache):
+    """Chainable twin of ag_chain through the bucketed primitive:
+    per-segment slice-own-chunk + ONE gather, expected_all_gather
+    semantics segment-wise."""
+    x = C.make_payload(rt.mesh, 8 * 64)  # [8, 512] int8
+    elems = x.shape[-1]
+    splits = (elems // 4, elems // 4, elems // 2)
+    got = np.asarray(cache.bucketed_ag_chain(rt.mesh, "d", splits, 1)(x))
+    host = C.host_payload(rt.mesh, 8 * 64)
+    segs = np.split(host, [elems // 4, elems // 2], axis=1)
+    want = np.concatenate([C.expected_all_gather(s) for s in segs],
+                          axis=1)
+    assert np.array_equal(got, want)
+    # Chained: each hop re-applies the per-segment diagonal concat.
+    got3 = np.asarray(
+        cache.bucketed_ag_chain(rt.mesh, "d", (elems // 2, elems // 2),
+                                3)(x))
+    w = host
+    for _ in range(3):
+        ss = np.split(w, [elems // 2], axis=1)
+        w = np.concatenate([C.expected_all_gather(s) for s in ss],
+                           axis=1)
+    assert np.array_equal(got3, w)
+
+
+def test_bucketed_ag_chain_rejects_indivisible_split(rt, cache):
+    with pytest.raises(ValueError, match="not divisible"):
+        cache.bucketed_ag_chain(rt.mesh, "d", (3, 5), 1)

@@ -73,3 +73,10 @@ def test_replace():
     cfg = BenchConfig().replace(iters=4, pattern="ring")
     assert cfg.iters == 4 and cfg.pattern == "ring"
     assert BenchConfig().iters == REF_ITERS
+
+
+def test_overlap_knob_validated_and_defaults_none():
+    assert BenchConfig().overlap == "none"
+    assert BenchConfig(overlap="prefetch").overlap == "prefetch"
+    with pytest.raises(ValueError, match="overlap"):
+        BenchConfig(overlap="prefetched")

@@ -118,6 +118,148 @@ def test_zero_dp_grads_and_moments_shard_like_params():
     assert np.isfinite(float(loss))
 
 
+# ------------------------------------------------------------- prefetch
+
+
+def test_split_plan_for_prefetch():
+    plan = {"wq": 2, "we1": 3, "emb": 0, "lnf": None, "odd": 0}
+    up, per = fsdp.split_plan_for_prefetch(
+        plan, stage_leaves=("wq", "we1", "odd"))
+    # Stage-major leaves with a non-stage sharded dim go per-stage...
+    assert per == {"wq": 2, "we1": 3}
+    # ...stage-less leaves, unplanned leaves, and stage-dim-sharded
+    # leaves stay upfront.
+    assert up == {"emb": 0, "lnf": None, "odd": 0}
+
+
+@pytest.mark.parametrize(
+    "rest", [(), (("tp", 2),), (("pp", 2),)],
+    ids=["dp4", "dp2xtp2", "dp2xpp2"])
+def test_prefetch_step_matches_none(rest):
+    # The tentpole parity contract: overlap="prefetch" (double-buffered
+    # per-stage bucketed gathers) must match overlap="none" (bulk
+    # gather) — the schedules move the same bytes, only *when* differs.
+    # Compared at the train-step surface (normalized update), the same
+    # tolerance the zero_dp-vs-replicated pin uses; the raw global-sum
+    # grads agree to f32 reassociation level (the bucketed gather's
+    # concat changes how XLA associates the transpose reductions).
+    n_dp = 4 if not rest else 2
+    mesh = _mesh_dp(n_dp, rest)
+    cfg_n = _cfg(zero_dp=True)
+    cfg_p = _cfg(zero_dp=True, overlap="prefetch")
+    params = F.init_flagship_params(cfg_n)
+    x, t = F.flagship_example_batch(cfg_n, mesh)
+    p_n = F.place_flagship_params(params, mesh, cfg_n)
+    p_p = F.place_flagship_params(params, mesh, cfg_p)
+    new_n, l_n = F.make_flagship_train_step(mesh, cfg_n, lr=1e-2)(
+        p_n, x, t)
+    new_p, l_p = F.make_flagship_train_step(mesh, cfg_p, lr=1e-2)(
+        p_p, x, t)
+    np.testing.assert_allclose(float(l_p), float(l_n), rtol=1e-6)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(new_p[k]), np.asarray(new_n[k]),
+            atol=1e-5, rtol=1e-5, err_msg=k,
+        )
+
+
+def test_prefetch_grads_shard_like_params_and_match_none():
+    # The per-stage gather's transpose must still deliver dp-sharded
+    # grads (the ZeRO contract), numerically matching the bulk path at
+    # gradient scale.
+    mesh = _mesh_dp(4)
+    cfg_n = _cfg(zero_dp=True)
+    cfg_p = _cfg(zero_dp=True, overlap="prefetch")
+    params = F.init_flagship_params(cfg_n)
+    x, t = F.flagship_example_batch(cfg_n, mesh)
+    p_n = F.place_flagship_params(params, mesh, cfg_n)
+    p_p = F.place_flagship_params(params, mesh, cfg_p)
+    g_n, l_n = F.make_flagship_grad_fn(mesh, cfg_n)(p_n, x, t)
+    g_p, l_p = F.make_flagship_grad_fn(mesh, cfg_p)(p_p, x, t)
+    np.testing.assert_allclose(float(l_p), float(l_n), rtol=1e-6)
+    for k in params:
+        assert g_p[k].sharding.is_equivalent_to(p_p[k].sharding,
+                                                p_p[k].ndim), k
+        a, b = np.asarray(g_p[k]), np.asarray(g_n[k])
+        scale = max(1.0, float(np.max(np.abs(b))))
+        np.testing.assert_allclose(a, b, atol=1e-5 * scale, rtol=1e-4,
+                                   err_msg=k)
+
+
+def test_prefetch_matches_none_under_remat():
+    # Remat recomputes the block, not the gather (the gathered slice
+    # is a checkpoint input); gradients stay identical to the
+    # no-remat prefetch step.
+    mesh = _mesh_dp(4)
+    cfg_p = _cfg(zero_dp=True, overlap="prefetch")
+    cfg_r = _cfg(zero_dp=True, overlap="prefetch", remat=True)
+    params = F.init_flagship_params(cfg_p)
+    x, t = F.flagship_example_batch(cfg_p, mesh)
+    p_p = F.place_flagship_params(params, mesh, cfg_p)
+    p_r = F.place_flagship_params(params, mesh, cfg_r)
+    new_p, l_p = F.make_flagship_train_step(mesh, cfg_p, lr=1e-2)(
+        p_p, x, t)
+    new_r, l_r = F.make_flagship_train_step(mesh, cfg_r, lr=1e-2)(
+        p_r, x, t)
+    np.testing.assert_allclose(float(l_r), float(l_p), rtol=1e-6)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(new_r[k]), np.asarray(new_p[k]),
+            atol=1e-5, rtol=1e-5, err_msg=k,
+        )
+
+
+def test_prefetch_lm_step_matches_none():
+    # LM config: the tied embedding (and lnf) are stage-less, so the
+    # prefetch path must gather them UPFRONT while the stack leaves go
+    # per-stage — the split_plan_for_prefetch seam, end to end.
+    mesh = _mesh_dp(4)
+    cfg_n = _cfg(zero_dp=True, vocab=64, norm=True)
+    cfg_p = _cfg(zero_dp=True, vocab=64, norm=True, overlap="prefetch")
+    params = F.init_flagship_params(cfg_n)
+    toks, tgts = F.flagship_token_batch(cfg_n, mesh)
+    p_n = F.place_flagship_params(params, mesh, cfg_n)
+    p_p = F.place_flagship_params(params, mesh, cfg_p)
+    new_n, l_n = F.make_flagship_lm_train_step(mesh, cfg_n, lr=1e-2)(
+        p_n, toks, tgts)
+    new_p, l_p = F.make_flagship_lm_train_step(mesh, cfg_p, lr=1e-2)(
+        p_p, toks, tgts)
+    np.testing.assert_allclose(float(l_p), float(l_n), rtol=1e-6)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(new_p[k]), np.asarray(new_n[k]),
+            atol=1e-5, rtol=1e-5, err_msg=k,
+        )
+
+
+def test_prefetch_one_device_mesh_degrades_to_noop():
+    # Topology edge case: a 1-sized dp axis yields an empty plan, so
+    # overlap="prefetch" must compile and run the plain path (no
+    # gather at all) and match overlap="none" bitwise.
+    mesh = _mesh_dp(1)
+    cfg_n = _cfg(zero_dp=True, batch=2, microbatches=1)
+    cfg_p = _cfg(zero_dp=True, batch=2, microbatches=1,
+                 overlap="prefetch")
+    assert F._fsdp_plan(mesh, cfg_p) is None
+    params = F.init_flagship_params(cfg_n)
+    x, t = F.flagship_example_batch(cfg_n, mesh)
+    p_n = F.place_flagship_params(params, mesh, cfg_n)
+    p_p = F.place_flagship_params(params, mesh, cfg_p)
+    new_n, l_n = F.make_flagship_train_step(mesh, cfg_n, lr=1e-2)(
+        p_n, x, t)
+    new_p, l_p = F.make_flagship_train_step(mesh, cfg_p, lr=1e-2)(
+        p_p, x, t)
+    assert float(l_p) == float(l_n)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(new_p[k]),
+                                      np.asarray(new_n[k]), err_msg=k)
+
+
+def test_overlap_knob_is_validated():
+    with pytest.raises(ValueError, match="overlap"):
+        _cfg(overlap="prefetched")
+
+
 def test_zero_dp_without_dp_axis_is_noop():
     mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("tp",))
     cfg = _cfg(zero_dp=True, heads=4)

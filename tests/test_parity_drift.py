@@ -53,7 +53,16 @@ QUOTES = (
 
 
 def newest_bench_detail():
-    """→ (path, detail dict) of the highest-numbered BENCH_r*.json."""
+    """→ (path, detail dict) of the highest-numbered BENCH_r*.json.
+
+    Degrades to a skip — never an AttributeError — when the artifact
+    carries ``parsed: null`` (the round-5 failure mode: the detail
+    dict outgrew the driver's 2000-byte stdout tail, truncating the
+    JSON line; bench.py's compact-headline contract fixes this
+    forward). Post-round-5 artifacts parse the compact line, whose
+    graded numbers live under ``headline`` — accepted as the detail
+    source so the drift guard keeps working across the format change.
+    """
     hits = sorted(
         (f for f in os.listdir(REPO)
          if re.fullmatch(r"BENCH_r\d+\.json", f)),
@@ -67,7 +76,23 @@ def newest_bench_detail():
     with open(path) as fh:
         art = json.load(fh)
     parsed = art.get("parsed", art)
-    return path, parsed.get("detail", {})
+    if not isinstance(parsed, dict):
+        pytest.skip(
+            f"{os.path.basename(path)} has no parsed bench JSON "
+            "(parsed: null — that round's final stdout line overflowed "
+            "the driver's tail window and did not parse; nothing to "
+            "check against)"
+        )
+    detail = parsed.get("detail")
+    if not isinstance(detail, dict):
+        detail = parsed.get("headline")
+    if not isinstance(detail, dict):
+        pytest.skip(
+            f"{os.path.basename(path)} parsed JSON carries neither "
+            "'detail' nor 'headline' — unknown artifact shape, "
+            "nothing to check against"
+        )
+    return path, detail
 
 
 def test_parity_perf_rows_match_newest_bench_artifact():

@@ -506,3 +506,97 @@ def test_cli_validate_timing_flag(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0  # CPU mesh: unjudged (no device track) -> success
     assert "timing-validation" in out
+
+
+def test_dropped_unnested_time_is_reported(tmp_path):
+    # Childless depth-0 events are excluded from leaf attribution by
+    # design (program mirrors, async transfer rows) — but the excluded
+    # TIME must be visible, or a trace violating the "ops are always
+    # nested" assumption silently under-attributes the program.
+    events = [
+        _meta(3, "/device:TPU:0"),
+        _ev(3, 1, "jit_step(1)", 100.0, 100.0),
+        _ev(3, 1, "fusion.1", 110.0, 40.0),
+        # An unnested op row: violates the nesting convention.
+        _ev(3, 1, "rogue-op.9", 250.0, 30.0),
+        # The program-mirror thread's childless jit span.
+        _ev(3, 2, "jit_step(1)", 100.0, 100.0),
+    ]
+    got = P.op_category_breakdown(_write_trace(tmp_path, events),
+                                  leaves=True)
+    assert got["fusion"]["seconds"] == pytest.approx(40e-6)
+    # Dropped: the rogue unnested op + the mirror-thread jit span (the
+    # tid-1 program span has a child, so it is not childless).
+    d = got["dropped_unnested"]
+    assert d["count"] == 2
+    assert d["seconds"] == pytest.approx((30 + 100) * 1e-6)
+    assert d["top"][0][0] == "jit_step(1)"
+    # Depth-1 mode is unchanged (no reserved key).
+    assert "dropped_unnested" not in P.op_category_breakdown(
+        _write_trace(tmp_path, events))
+
+
+def test_gather_overlap_fraction_bridges_async_pairs(tmp_path):
+    # An async all-gather (start at 100, done ends at 200) overlapped
+    # by a fusion on [120, 180]: the gather interval is the bridged
+    # [100, 200] span, of which 60 us sits under compute -> 0.6.
+    events = [
+        _meta(3, "/device:TPU:0"),
+        _ev(3, 1, "jit_step(1)", 90.0, 220.0),
+        _ev(3, 1, "all-gather-start.3", 100.0, 10.0),
+        _ev(3, 1, "fusion.1", 120.0, 60.0),
+        _ev(3, 1, "all-gather-done.3", 195.0, 5.0),
+    ]
+    ov = P.gather_overlap_fraction(_write_trace(tmp_path, events))
+    assert ov["gather_s"] == pytest.approx(100e-6)
+    assert ov["hidden_s"] == pytest.approx(60e-6)
+    assert ov["frac"] == pytest.approx(0.6)
+    assert ov["compute_s"] == pytest.approx(60e-6)
+
+
+def test_gather_overlap_fraction_sync_gather_and_window(tmp_path):
+    # A synchronous all-gather op overlaps nothing: frac 0. Windowing
+    # clips both sides.
+    events = [
+        _meta(3, "/device:TPU:0"),
+        _ev(3, 1, "jit_step(1)", 90.0, 220.0),
+        _ev(3, 1, "all-gather.4", 100.0, 50.0),
+        _ev(3, 1, "fusion.1", 160.0, 40.0),
+    ]
+    ov = P.gather_overlap_fraction(_write_trace(tmp_path, events))
+    assert ov["frac"] == pytest.approx(0.0)
+    assert ov["gather_s"] == pytest.approx(50e-6)
+    # Window excluding the gather: nothing to hide -> frac None.
+    ov2 = P.gather_overlap_fraction(_write_trace(tmp_path, events),
+                                    window=(155e-6, 210e-6))
+    assert ov2["frac"] is None and ov2["gather_s"] == 0.0
+
+
+def test_gather_overlap_fraction_no_device_track(tmp_path):
+    events = [_meta(701, "/host:CPU"), _ev(701, 1, "x", 0.0, 10.0)]
+    assert P.gather_overlap_fraction(_write_trace(tmp_path, events)) \
+        is None
+
+
+def test_interval_helpers():
+    u = P._interval_union([(0, 2), (1, 3), (5, 6)])
+    assert u == [(0, 3), (5, 6)]
+    assert P._union_len(u) == 4
+    assert P._intersect_len(u, [(2, 5.5)]) == pytest.approx(1.5)
+    assert P._intersect_len([], u) == 0.0
+
+
+def test_all_unnested_trace_still_reports_dropped(tmp_path):
+    # A trace whose EVERY op row violates the nesting convention must
+    # not come back as {} — that would vanish all device time, the
+    # exact silent under-attribution dropped_unnested exists to catch.
+    events = [
+        _meta(3, "/device:TPU:0"),
+        _ev(3, 1, "fusion.1", 100.0, 40.0),
+        _ev(3, 1, "copy.2", 150.0, 20.0),
+    ]
+    got = P.op_category_breakdown(_write_trace(tmp_path, events),
+                                  leaves=True)
+    assert list(got) == ["dropped_unnested"]
+    assert got["dropped_unnested"]["count"] == 2
+    assert got["dropped_unnested"]["seconds"] == pytest.approx(60e-6)

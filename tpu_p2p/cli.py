@@ -91,6 +91,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attn-window", type=int, default=0, metavar="W",
                    help="ring/ulysses_attention: sliding-window attention; "
                         "windowed contiguous rings drop provably-dead hops")
+    p.add_argument("--zero-dp", action="store_true",
+                   help="flagship_step: ZeRO-3/FSDP param sharding over "
+                        "the dp axis")
+    p.add_argument("--overlap", choices=("none", "prefetch"),
+                   default="none",
+                   help="flagship_step + --zero-dp: FSDP gather schedule "
+                        "(prefetch = double-buffered per-layer all-gather "
+                        "overlapped with compute)")
     p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
                    help="testing: force CPU platform with N simulated devices")
     p.add_argument("--list-devices", action="store_true",
@@ -127,6 +135,8 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         profile_dir=args.profile_dir,
         use_flash=args.flash,
         attn_window=args.attn_window,
+        zero_dp=args.zero_dp,
+        overlap=args.overlap,
     )
 
 

@@ -120,6 +120,14 @@ class BenchConfig:
     # workloads — windowed contiguous rings also DROP dead hops
     # (tpu_p2p.ops.attention.live_ring_hops), which this surface makes
     # measurable as shipped bytes
+    overlap: str = "none"  # flagship_step: FSDP parameter-gather
+    # scheduling ("none" = bulk gather before the forward, "prefetch"
+    # = double-buffered per-layer gather overlapped with compute);
+    # mirrors FlagshipConfig.overlap, see tpu_p2p/parallel/fsdp.py.
+    # Only meaningful with zero_dp and a dp axis; other patterns
+    # ignore it.
+    zero_dp: bool = False  # flagship_step: ZeRO-3/FSDP param sharding
+    # over the dp axis (FlagshipConfig.zero_dp)
 
     def __post_init__(self) -> None:
         if self.pattern not in PATTERNS:
@@ -135,6 +143,11 @@ class BenchConfig:
         if self.attn_window < 0:
             raise ValueError(
                 f"attn_window must be >= 0, got {self.attn_window}"
+            )
+        if self.overlap not in ("none", "prefetch"):
+            raise ValueError(
+                f"unknown overlap {self.overlap!r}; expected 'none' "
+                "or 'prefetch'"
             )
 
     @property

@@ -68,6 +68,7 @@ from tpu_p2p.models.flagship_params import (  # noqa: F401
 from tpu_p2p.models.flagship_forward import (  # noqa: F401
     _dense_ffn,
     _forward_local,
+    _fsdp_prepare,
     _lm_logits_local,
     _pipeline_schedule,
     _rms_norm,

@@ -58,6 +58,16 @@ class FlagshipConfig:
     # optimizer moments) sharded over dp, all-gathered on use inside
     # the step; autodiff turns the gather's transpose into the ZeRO
     # gradient reduce-scatter. See tpu_p2p/parallel/fsdp.py.
+    overlap: str = "none"    # FSDP parameter-gather scheduling (only
+    # meaningful with zero_dp=True and a dp axis > 1):
+    # "none" — one bulk gather of every leaf before the forward, XLA's
+    # implicit scheduling decides what overlaps (the byte-identical
+    # baseline); "prefetch" — explicit ZeRO-3 double buffer: the
+    # per-layer loop issues the bucketed all-gather for layer i+1's
+    # stage slice while layer i computes, and the backward's per-stage
+    # gradient reduce-scatters interleave symmetrically (the gather's
+    # autodiff transpose). Loss/grads are numerically identical either
+    # way (tests/test_fsdp.py); docs/fsdp_overlap.md has the schedule.
     use_flash: bool = False  # Pallas flash kernel for the attention
     # math, trainable under every sp_strategy: Ulysses sees the full
     # sequence locally (the standalone custom-vjp kernel drops in);
@@ -115,6 +125,14 @@ class FlagshipConfig:
             )
         if self.attn_window and not self.causal:
             raise ValueError("attn_window requires causal=True")
+        # Strict like sp_strategy: a typo ("prefetched", "Prefetch")
+        # would silently train on the bulk-gather path while the run's
+        # logs claim overlap.
+        if self.overlap not in ("none", "prefetch"):
+            raise ValueError(
+                f"unknown overlap {self.overlap!r}; expected 'none' "
+                "or 'prefetch'"
+            )
         # Strict: a typo'd policy name must fail at config time, not
         # trace deep inside the step builder. hasattr alone is not
         # enough — jax.checkpoint_policies also exposes FACTORIES
@@ -132,7 +150,14 @@ class FlagshipConfig:
             if usable:
                 try:
                     usable = not callable(pol(jax.lax.add_p))
-                except TypeError:
+                except Exception:  # noqa: BLE001 — any probe failure
+                    # means "not a usable policy": factories reject the
+                    # primitive with TypeError today, but a factory is
+                    # free to raise anything (ValueError on a bad arg,
+                    # AttributeError poking at it), and every such case
+                    # must yield the SAME unknown-remat_policy error
+                    # below, not leak an unrelated traceback from a
+                    # config probe (ADVICE.md round 5, low).
                     usable = False
             if not usable:
                 raise ValueError(

@@ -20,6 +20,7 @@ from tpu_p2p.models.flagship_config import (
 )
 from tpu_p2p.models.flagship_params import (
     Params,
+    STAGELESS_LEAVES,
     _fsdp_plan,
     _lm_token_spec,
     flagship_data_spec,
@@ -110,8 +111,20 @@ def _dense_ffn(sub_params: Params, h, tp):
 
 
 def _stage_block(stage_params: Params, x, cfg: FlagshipConfig,
-                 s_local: int, sp, tp, ep):
-    """Apply this pp rank's ``s_local`` consecutive sub-blocks."""
+                 s_local: int, sp, tp, ep, prefetch=None):
+    """Apply this pp rank's ``s_local`` consecutive sub-blocks.
+
+    ``prefetch``: ``None`` — every leaf arrives fully gathered and is
+    sliced per stage (the baseline). Or ``(dp_axis, per_stage_plan)``
+    — the planned leaves arrive still dp-sharded and are all-gathered
+    one stage slice AHEAD of use: the loop issues stage ``i+1``'s
+    bucketed gather before stage ``i``'s compute consumes the
+    already-gathered buffer, so the gather's output has no consumer in
+    stage ``i``'s ops and XLA's async all-gather overlaps the transfer
+    with the matmuls (the ring_flash KV-prefetch trick, applied to
+    ZeRO-3 params). Double buffer: at most two stages' full params
+    live at once.
+    """
     compute = jnp.dtype(cfg.dtype)
 
     def cast_and_run(sub, x, cfg, sp, tp, ep):
@@ -136,18 +149,40 @@ def _stage_block(stage_params: Params, x, cfg: FlagshipConfig,
                   if cfg.remat_policy else None)
         body = jax.checkpoint(cast_and_run, static_argnums=(2, 3, 4, 5),
                               policy=policy)
+    if prefetch is None:
+        for i in range(s_local):
+            sub = {k: v[i] for k, v in stage_params.items()}
+            x = body(sub, x, cfg, sp, tp, ep)
+        return x
+    from tpu_p2p.parallel import fsdp
+
+    axis, plan = prefetch
+    cur = fsdp.gather_stage(stage_params, 0, axis, plan)
     for i in range(s_local):
-        sub = {k: v[i] for k, v in stage_params.items()}
+        # Issue the NEXT stage's gather before this stage's compute:
+        # nothing below consumes it, so the collective runs async
+        # under the matmuls. (The gather sits outside the remat
+        # boundary on purpose — re-gathering inside the backward would
+        # re-pay the collective; the gathered slice is a saved
+        # checkpoint input, same liveness as the baseline's bulk
+        # gather.)
+        nxt = (fsdp.gather_stage(stage_params, i + 1, axis, plan)
+               if i + 1 < s_local else None)
+        sub = {k: (cur[k] if k in cur else v[i])
+               for k, v in stage_params.items()}
         x = body(sub, x, cfg, sp, tp, ep)
+        cur = nxt
     return x
 
 
-def _pipeline_schedule(stage_params, x_mb, cfg, s_local, pp, sp, tp, ep):
+def _pipeline_schedule(stage_params, x_mb, cfg, s_local, pp, sp, tp, ep,
+                       prefetch=None):
     """GPipe ticks over the pp axis — delegates to
     :func:`tpu_p2p.models.pipeline.pipeline_apply_local` with the
     transformer stage block; ``pp=None`` runs the stages sequentially."""
     def block_fn(params, x):
-        return _stage_block(params, x, cfg, s_local, sp, tp, ep)
+        return _stage_block(params, x, cfg, s_local, sp, tp, ep,
+                            prefetch=prefetch)
 
     if pp is None:
         return jnp.stack(
@@ -156,7 +191,8 @@ def _pipeline_schedule(stage_params, x_mb, cfg, s_local, pp, sp, tp, ep):
     return pipeline_apply_local(block_fn, stage_params, x_mb, pp)
 
 
-def _forward_local(params, x, cfg: FlagshipConfig, mesh_axes):
+def _forward_local(params, x, cfg: FlagshipConfig, mesh_axes,
+                   prefetch=None):
     dp, pp, sp, tp, ep = (mesh_axes.get(a) for a in AXES)
     del dp
     pp_size = jax.lax.axis_size(pp) if pp is not None else 1
@@ -173,21 +209,48 @@ def _forward_local(params, x, cfg: FlagshipConfig, mesh_axes):
         )
     x_mb = x.reshape((cfg.microbatches, b_loc // cfg.microbatches)
                      + x.shape[1:])
-    y_mb = _pipeline_schedule(params, x_mb, cfg, s_local, pp, sp, tp, ep)
+    y_mb = _pipeline_schedule(params, x_mb, cfg, s_local, pp, sp, tp, ep,
+                              prefetch=prefetch)
     return y_mb.reshape(x.shape)
+
+
+def _fsdp_prepare(params, cfg: FlagshipConfig, plan):
+    """Apply the FSDP gather schedule the config asks for.
+
+    → ``(params, prefetch)``: under ``overlap="none"`` (or no plan)
+    every planned leaf is bulk-gathered here and ``prefetch`` is
+    ``None`` — byte-identical to the pre-overlap-knob path. Under
+    ``overlap="prefetch"`` only the leaves the per-stage schedule
+    cannot cover (stage-less emb/lnf, stage-dim-sharded leaves) are
+    gathered upfront; the rest stay dp-sharded and ``prefetch``
+    carries ``("dp", per_stage_plan)`` for the double-buffered
+    per-layer gathers in :func:`_stage_block`. The ONE seam every
+    step/forward builder goes through, so the two schedules cannot
+    drift apart.
+    """
+    from tpu_p2p.parallel import fsdp
+
+    if not plan:
+        return params, None
+    if cfg.overlap != "prefetch":
+        return fsdp.all_gather_params(params, "dp", plan), None
+    # Stage-major leaves are everything _forward_local's stage loop
+    # slices; STAGELESS_LEAVES live outside the stack
+    # (_lm_logits_local strips them with the same constant).
+    stage_leaves = set(params) - set(STAGELESS_LEAVES)
+    upfront, per_stage = fsdp.split_plan_for_prefetch(plan, stage_leaves)
+    params = fsdp.all_gather_params(params, "dp", upfront)
+    return params, (("dp", per_stage) if per_stage else None)
 
 
 def make_flagship_forward(mesh: Mesh, cfg: FlagshipConfig):
     """Jitted forward over the 5-axis mesh: global [B, T, Dm] → same."""
-    from tpu_p2p.parallel import fsdp
-
     axes = _mesh_axes(mesh)
     plan = _fsdp_plan(mesh, cfg)
 
     def f(params, x):
-        if plan:
-            params = fsdp.all_gather_params(params, "dp", plan)
-        return _forward_local(params, x, cfg, axes)
+        params, prefetch = _fsdp_prepare(params, cfg, plan)
+        return _forward_local(params, x, cfg, axes, prefetch=prefetch)
 
     sm = jax.shard_map(
         f, mesh=mesh,
@@ -197,7 +260,8 @@ def make_flagship_forward(mesh: Mesh, cfg: FlagshipConfig):
     return jax.jit(sm)
 
 
-def _lm_logits_local(params, tokens, cfg: FlagshipConfig, axes):
+def _lm_logits_local(params, tokens, cfg: FlagshipConfig, axes,
+                     prefetch=None):
     """Embed → transformer stack → tied unembed, per shard — the one
     definition of the LM head, shared by the forward and the train
     step so the reported loss can never diverge from the forward's
@@ -209,8 +273,9 @@ def _lm_logits_local(params, tokens, cfg: FlagshipConfig, axes):
     # The stack sees only stage-major leaves: _stage_block slices every
     # leaf by stage index; emb (vocab-leading) and lnf (stage-less) are
     # applied here around it.
-    stack = {k: v for k, v in params.items() if k not in ("emb", "lnf")}
-    y = _forward_local(stack, x, cfg, axes)
+    stack = {k: v for k, v in params.items()
+             if k not in STAGELESS_LEAVES}
+    y = _forward_local(stack, x, cfg, axes, prefetch=prefetch)
     if cfg.norm:
         y = _rms_norm(y, params["lnf"])
     # Unembed in the compute dtype with f32 accumulation: under bf16
@@ -226,17 +291,15 @@ def _lm_logits_local(params, tokens, cfg: FlagshipConfig, axes):
 def make_flagship_lm_forward(mesh: Mesh, cfg: FlagshipConfig):
     """Jitted LM forward: global token ids ``[B, T]`` → logits
     ``[B, T, vocab]``."""
-    from tpu_p2p.parallel import fsdp
-
     if not cfg.vocab:
         raise ValueError("cfg.vocab must be > 0 for the LM forward")
     axes = _mesh_axes(mesh)
     plan = _fsdp_plan(mesh, cfg)
 
     def f(params, tokens):
-        if plan:
-            params = fsdp.all_gather_params(params, "dp", plan)
-        return _lm_logits_local(params, tokens, cfg, axes)
+        params, prefetch = _fsdp_prepare(params, cfg, plan)
+        return _lm_logits_local(params, tokens, cfg, axes,
+                                prefetch=prefetch)
 
     tok_spec = _lm_token_spec(mesh)
     sm = jax.shard_map(

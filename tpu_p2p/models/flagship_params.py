@@ -19,6 +19,13 @@ from tpu_p2p.models.flagship_config import FlagshipConfig, _axis
 
 Params = Dict[str, jax.Array]
 
+# Leaves with NO leading stage dim — applied around the transformer
+# stack (_lm_logits_local), never sliced by the per-stage loop, and
+# excluded from the FSDP per-stage prefetch schedule (_fsdp_prepare).
+# The ONE definition; adding a stage-less leaf only here keeps every
+# consumer consistent.
+STAGELESS_LEAVES = ("emb", "lnf")
+
 
 def flagship_param_shapes(cfg: FlagshipConfig) -> Dict[str, Tuple[int, ...]]:
     """Parameter shapes from the config alone (no initialization) —
@@ -122,7 +129,8 @@ def flagship_param_specs(mesh: Mesh,
     else:
         # No config: every stage-major leaf (pipelined placement looks
         # specs up per param key); the stage-less leaves are excluded.
-        specs = {k: v for k, v in specs.items() if k not in ("emb", "lnf")}
+        specs = {k: v for k, v in specs.items()
+                 if k not in STAGELESS_LEAVES}
     return specs
 
 

@@ -19,6 +19,7 @@ from tpu_p2p.models.flagship_config import (
 )
 from tpu_p2p.models.flagship_forward import (
     _forward_local,
+    _fsdp_prepare,
     _lm_logits_local,
 )
 from tpu_p2p.models.flagship_params import (
@@ -49,8 +50,6 @@ def make_flagship_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
     come back sharded exactly like the params, so any optimizer's
     elementwise update runs shard-local under ``jit``.
     """
-    from tpu_p2p.parallel import fsdp
-
     axes = _mesh_axes(mesh)
     plan = _fsdp_plan(mesh, cfg)
     specs = flagship_param_specs(mesh, cfg)
@@ -59,10 +58,13 @@ def make_flagship_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
         def local_loss(p):
             # ZeRO gather-on-use sits inside the differentiated
             # function: its transpose is the gradient psum_scatter, so
-            # grads come back dp-sharded like the params.
-            if plan:
-                p = fsdp.all_gather_params(p, "dp", plan)
-            out = _forward_local(p, x, cfg, axes)
+            # grads come back dp-sharded like the params. Under
+            # cfg.overlap="prefetch" the gathers move into the
+            # per-layer loop (double buffer) and their transposes
+            # become per-stage reduce-scatters interleaved with the
+            # backward's compute (docs/fsdp_overlap.md).
+            p, prefetch = _fsdp_prepare(p, cfg, plan)
+            out = _forward_local(p, x, cfg, axes, prefetch=prefetch)
             return jnp.sum(
                 (out.astype(jnp.float32) - target.astype(jnp.float32)) ** 2
             )
@@ -107,8 +109,6 @@ def make_flagship_lm_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
     """Jitted ``(params, tokens, targets) → (grads, summed CE)`` —
     the LM twin of :func:`make_flagship_grad_fn` (same contract: raw
     global-sum loss and grads; step builders own the normalization)."""
-    from tpu_p2p.parallel import fsdp
-
     if not cfg.vocab:
         raise ValueError("cfg.vocab must be > 0 for the LM step")
     axes = _mesh_axes(mesh)
@@ -117,8 +117,9 @@ def make_flagship_lm_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
 
     def gstep(params, tokens, targets):
         def local_loss(p):
-            pf = fsdp.all_gather_params(p, "dp", plan) if plan else p
-            logits = _lm_logits_local(pf, tokens, cfg, axes)
+            pf, prefetch = _fsdp_prepare(p, cfg, plan)
+            logits = _lm_logits_local(pf, tokens, cfg, axes,
+                                      prefetch=prefetch)
             # CE via logsumexp rather than materializing
             # log_softmax's full [B, T, V] tensor: sum(nll) =
             # sum(logsumexp(logits)) - sum(logits[target]) exactly

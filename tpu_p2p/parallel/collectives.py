@@ -170,6 +170,107 @@ def _canon_edges(edges: Sequence[Edge], axis_size: int) -> Tuple[Edge, ...]:
     return canon
 
 
+def _promote_vma(arrays):
+    """Promote every array to the union of their varying-mesh-axes
+    sets — under a vma-checked ``shard_map`` (new-jax default),
+    ``concatenate`` operands must agree on vma, and FSDP leaves
+    legitimately differ (an attention projection varies over ``tp``
+    where the router does not). The same promotion
+    :func:`tpu_p2p.ops.attention._union_vma` applies around scans,
+    inlined here to keep the layering (ops sit above this module).
+    No-op on jax versions without the vma type system."""
+    if len(arrays) < 2 or not hasattr(jax, "typeof"):
+        return arrays
+    vmas = [getattr(jax.typeof(a), "vma", frozenset()) for a in arrays]
+    union = frozenset().union(*vmas)
+    return [
+        jax.lax.pcast(a, tuple(union - v), to="varying")
+        if union - v else a
+        for a, v in zip(arrays, vmas)
+    ]
+
+
+def _gather_buckets(items, bucket_bytes):
+    """Greedy split of ``[(name, shard, dim), ...]`` into buckets of at
+    most ``bucket_bytes`` of local-shard payload each (a shard larger
+    than the cap gets its own bucket). ``None`` = one bucket."""
+    if bucket_bytes is None:
+        return [items]
+    buckets, cur, cur_bytes = [], [], 0
+    for it in items:
+        nbytes = it[1].size * it[1].dtype.itemsize
+        if cur and cur_bytes + nbytes > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(it)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def bucketed_all_gather(shards, axis: str, bucket_bytes=None):
+    """Gather many dp-sharded arrays in one collective per bucket.
+
+    ``shards``: ``{name: (local_shard, gather_dim)}`` — each value is
+    the local block of an array sharded along ``gather_dim`` over mesh
+    axis ``axis``; the result maps each name to the full (gathered)
+    array, exactly ``jax.lax.all_gather(shard, axis, axis=gather_dim,
+    tiled=True)`` per leaf — but paying ONE all-gather per
+    dtype-bucket instead of one per leaf. This is the ZeRO bucketing
+    trick: per-leaf gathers of many small parameters serialize on
+    per-collective launch/setup cost; flattening the shards into one
+    buffer moves the same bytes in a single op, which both amortizes
+    that cost and gives the scheduler one big transfer to overlap with
+    compute (tpu_p2p/parallel/fsdp.py prefetch path).
+
+    Mechanics: shards of one dtype are raveled and concatenated, one
+    untiled ``all_gather`` produces ``[axis_size, total]``, and each
+    leaf is carved back out — ``moveaxis`` of the leading gather axis
+    to ``gather_dim`` followed by a merge reshape IS the tiled-gather
+    block concatenation, so the per-leaf result is bit-identical to
+    the per-leaf gather. Traceable (call inside ``shard_map``), and
+    differentiable: the transpose of gather+slice is the same bucketed
+    ``psum_scatter``, so ZeRO gradient reduce-scatters bucket too.
+
+    ``bucket_bytes``: optional cap on local-shard bytes per collective
+    (chunked gathers — lets a scheduler start compute on early buckets
+    while later ones are still in flight). ``None`` = one bucket per
+    dtype. Groups are split by dtype because concatenation requires
+    one element type; mixed-dtype param sets just pay one op per type.
+    """
+    # Validate BEFORE the trivial-axis return: a mis-built plan must
+    # fail on the 1-device dev mesh too, not only once it reaches a
+    # real multi-device axis.
+    for k, (v, d) in shards.items():
+        if not 0 <= d < v.ndim:
+            raise ValueError(f"{k}: gather dim {d} out of range for "
+                             f"rank-{v.ndim} shard")
+    n = jax.lax.axis_size(axis)
+    if n == 1:  # trivial axis: every shard already is the full array
+        return {k: v for k, (v, _) in shards.items()}
+    out = {}
+    by_dtype: Dict = {}
+    for k, (v, d) in shards.items():
+        by_dtype.setdefault(jnp.dtype(v.dtype), []).append((k, v, d))
+    for items in by_dtype.values():
+        for bucket in _gather_buckets(items, bucket_bytes):
+            flat = (bucket[0][1].reshape(-1) if len(bucket) == 1
+                    else jnp.concatenate(_promote_vma(
+                        [v.reshape(-1) for _, v, _ in bucket])))
+            rows = jax.lax.all_gather(flat, axis)  # [n, sum(sizes)]
+            off = 0
+            for k, v, d in bucket:
+                seg = jax.lax.slice_in_dim(rows, off, off + v.size,
+                                           axis=1)
+                seg = seg.reshape((n,) + v.shape)
+                out[k] = jnp.moveaxis(seg, 0, d).reshape(
+                    v.shape[:d] + (n * v.shape[d],) + v.shape[d + 1:]
+                )
+                off += v.size
+    return out
+
+
 class CollectiveCache:
     """Compile-once cache of jitted collective programs.
 
@@ -481,6 +582,68 @@ class CollectiveCache:
             )
 
         return self._get(key, build)
+
+    def bucketed_ag_chain(self, mesh: Mesh, axis: str,
+                          splits: Sequence[int], count: int):
+        """``count`` hops of slice-own-chunks + ONE bucketed
+        ``all_gather`` covering ``len(splits)`` logical parameters —
+        the transport of the FSDP prefetch path
+        (:func:`tpu_p2p.parallel.fsdp.gather_stage`), chainable like
+        :meth:`ag_chain` so the bucketing win (one collective where
+        per-param gathers pay ``len(splits)`` launches) is directly
+        measurable against it.
+
+        ``splits``: element counts carving the payload dim into the
+        logical params; each must divide by the axis size and they
+        must sum to the payload's trailing dim. Shape-preserving
+        (per-segment diagonal-concat semantics, exactly
+        :func:`expected_all_gather` segment-wise).
+        """
+        splits = tuple(int(s) for s in splits)
+        edges_key = ("bucketed_ag_chain", mesh, axis, splits, count)
+        n = mesh.shape[axis]
+        for s in splits:
+            if s % n:
+                raise ValueError(
+                    f"split {s} not divisible by axis size {n}")
+
+        def build():
+            spec = P(*mesh.axis_names, None)
+            offs = [0]
+            for s in splits:
+                offs.append(offs[-1] + s)
+
+            def f(x):
+                if offs[-1] != x.shape[-1]:
+                    raise ValueError(
+                        f"splits sum to {offs[-1]} but payload has "
+                        f"{x.shape[-1]} elems")
+
+                def step(carry, _):
+                    idx = jax.lax.axis_index(axis)
+                    shards = {}
+                    for j, sz in enumerate(splits):
+                        seg = jax.lax.slice_in_dim(
+                            carry, offs[j], offs[j + 1],
+                            axis=carry.ndim - 1)
+                        c = sz // n
+                        own = jax.lax.dynamic_slice_in_dim(
+                            seg, idx * c, c, seg.ndim - 1)
+                        shards[str(j)] = (own, own.ndim - 1)
+                    full = bucketed_all_gather(shards, axis)
+                    return jnp.concatenate(
+                        [full[str(j)] for j in range(len(splits))],
+                        axis=carry.ndim - 1,
+                    ), None
+
+                out, _ = jax.lax.scan(step, x, None, length=count)
+                return out
+
+            return jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+            )
+
+        return self._get(edges_key, build)
 
     def __len__(self) -> int:
         return len(self._cache)

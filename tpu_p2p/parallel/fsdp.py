@@ -21,11 +21,27 @@ supplies the strategy for the framework's model layer, TPU-first:
   the axis; parameters with no such dimension stay replicated
   (correct, just not memory-scaled). The plan is shape-arithmetic on
   the host — nothing dynamic reaches the compiled program.
+- **Explicit prefetch** (``overlap="prefetch"``): instead of one bulk
+  gather of every leaf before the forward ("shard + pray XLA
+  overlaps"), :func:`split_plan_for_prefetch` +
+  :func:`gather_stage` schedule a ZeRO-3-style double buffer — the
+  per-layer loop issues the bucketed all-gather for layer *i+1*'s
+  stage slice BEFORE layer *i*'s matmuls consume the already-gathered
+  buffer, the same issue-before-consume trick
+  ``tpu_p2p/ops/ring_flash.py`` uses for KV blocks, so XLA's async
+  all-gather(-start/-done) overlaps the transfer with compute. The
+  gathers stay inside the differentiated function, so autodiff's
+  transpose turns each per-stage gather into a per-stage gradient
+  ``psum_scatter`` interleaved with the backward's compute — the
+  symmetric reduce-scatter overlap, no hand-written plumbing. At most
+  two stages' full params are live at once (vs every stage under the
+  bulk gather), and a 1-sized axis degrades to a no-op
+  (:func:`fsdp_plan` emits an empty plan there).
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, Iterable, Optional, Tuple
 
 import jax
 from jax.sharding import PartitionSpec as P
@@ -77,3 +93,44 @@ def all_gather_params(params: Dict[str, jax.Array], axis: str,
             if plan.get(k) is not None else v)
         for k, v in params.items()
     }
+
+
+def split_plan_for_prefetch(plan: Plan,
+                            stage_leaves: Iterable[str]) -> Tuple[Plan, Plan]:
+    """Split a ZeRO plan into ``(upfront, per_stage)`` for the
+    double-buffered prefetch schedule.
+
+    ``per_stage`` keeps the stage-major leaves whose sharded dim is
+    NOT the leading stage dim — those can be gathered one stage slice
+    at a time (slice first, then gather only that stage's bytes).
+    Everything else stays ``upfront``: stage-less leaves (tied
+    embedding, final norm gain), leaves the plan left replicated, and
+    the rare leaf whose *stage* dim is the dp-sharded one (a per-stage
+    slice of its local shard would not be one stage's params).
+    """
+    stage_leaves = set(stage_leaves)
+    per_stage = {k: d for k, d in plan.items()
+                 if d is not None and d > 0 and k in stage_leaves}
+    upfront = {k: d for k, d in plan.items() if k not in per_stage}
+    return upfront, per_stage
+
+
+def gather_stage(stage_params: Dict[str, jax.Array], index: int, axis: str,
+                 per_stage_plan: Plan,
+                 bucket_bytes: Optional[int] = None) -> Dict[str, jax.Array]:
+    """All-gather ONE stage's slice of every per-stage-planned leaf,
+    as a single bucketed collective.
+
+    ``stage_params`` leaves are stage-major local shards (leading
+    stage dim intact); ``per_stage_plan`` dims are in full-array
+    coordinates, so slicing off the stage dim shifts each by one. The
+    call sits inside the differentiated per-layer loop
+    (``flagship_forward._stage_block``); its transpose is the stage's
+    gradient reduce-scatter (+ zero-padded accumulation into the
+    stage-major grad), which is exactly the backward-side overlap.
+    """
+    from tpu_p2p.parallel.collectives import bucketed_all_gather
+
+    shards = {k: (stage_params[k][index], per_stage_plan[k] - 1)
+              for k in per_stage_plan if k in stage_params}
+    return bucketed_all_gather(shards, axis, bucket_bytes=bucket_bytes)

@@ -385,6 +385,10 @@ def _build_parser() -> argparse.ArgumentParser:
                    choices=("ring", "ring_zigzag", "ulysses"))
     for flag in ("flash", "norm", "dense-ffn", "rope", "remat", "zero-dp"):
         p.add_argument(f"--{flag}", action="store_true")
+    p.add_argument("--overlap", default="none",
+                   choices=("none", "prefetch"),
+                   help="with --zero-dp: FSDP gather schedule (prefetch "
+                        "= double-buffered per-layer all-gather)")
     return p
 
 
@@ -413,7 +417,7 @@ def main(argv=None) -> int:
         param_dtype=args.param_dtype,
         sp_strategy=args.sp_strategy, use_flash=args.flash,
         norm=args.norm, dense_ffn=args.dense_ffn, rope=args.rope,
-        remat=args.remat, zero_dp=args.zero_dp,
+        remat=args.remat, zero_dp=args.zero_dp, overlap=args.overlap,
     )
     summary = run_training(
         mesh, cfg, steps=args.steps, lr=args.lr, seed=args.seed,

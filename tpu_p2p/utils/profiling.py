@@ -41,6 +41,7 @@ __all__ = [
     "device_top_level_events",
     "device_leaf_events",
     "differential_from_trace",
+    "gather_overlap_fraction",
     "validate_differential",
     "measure_headline",
 ]
@@ -187,17 +188,24 @@ def categorize_op(name: str) -> str:
     return "other"
 
 
-def device_leaf_events(trace_dir: str) -> List[DeviceEvent]:
-    """Innermost (childless) events on device tracks.
+def _leaf_and_dropped_events(trace_dir: str, loaded=None):
+    """→ ``(leaves, dropped)``: innermost nested device events, plus
+    the childless depth-0 events the leaf view excludes by design.
 
-    Depth-1 attribution (:func:`device_op_events`) is blind inside
-    control flow: a step structured as ``lax.scan`` loops shows up as
-    one opaque ``while`` op covering 80-90% of the program (measured
-    on the round-5 production-shape LM step). Leaf events descend to
-    the ops the device actually ran — and, like depth-1, they cannot
-    double-count: no leaf contains another event.
+    The exclusion rule (see :func:`device_leaf_events`) assumes real
+    op rows are always nested inside their program's jit_* span; the
+    ``dropped`` list is returned so callers can *account* for the time
+    that assumption throws away instead of losing it silently — a
+    trace that violates it (ops recorded unnested) would otherwise
+    read as a shorter program than the device ran.
+
+    ``loaded``: optional pre-parsed ``(xs, pid_names)`` from
+    :func:`load_trace_events`, so a caller that already paid the
+    gzip+JSON parse (traces are routinely tens of MB) does not pay it
+    twice.
     """
-    xs, pid_names = load_trace_events(trace_dir)
+    xs, pid_names = (load_trace_events(trace_dir) if loaded is None
+                     else loaded)
     dev_pids = {p for p, n in pid_names.items()
                 if str(n).startswith("/device:")}
     by_track: dict = {}
@@ -205,6 +213,7 @@ def device_leaf_events(trace_dir: str) -> List[DeviceEvent]:
         if e["pid"] in dev_pids:
             by_track.setdefault((e["pid"], e["tid"]), []).append(e)
     out: List[DeviceEvent] = []
+    dropped: List[DeviceEvent] = []
     for (pid, tid), evs in by_track.items():
         evs.sort(key=lambda e: (e["ts"], -e["dur"]))
         stack: list = []  # [(end_ts, event, had_child, depth)]
@@ -220,8 +229,8 @@ def device_leaf_events(trace_dir: str) -> List[DeviceEvent]:
                 # trace), the second thread's top-level op-row copies,
                 # and async copy-start/copy-done transfer rows — all
                 # of which depth-1 attribution also excludes.
-                if not had_child and depth > 0:
-                    out.append(DeviceEvent(
+                if not had_child:
+                    (out if depth > 0 else dropped).append(DeviceEvent(
                         name=ev.get("name", ""), ts=ev["ts"] / 1e6,
                         dur=ev["dur"] / 1e6, pid=pid, tid=tid,
                     ))
@@ -234,7 +243,25 @@ def device_leaf_events(trace_dir: str) -> List[DeviceEvent]:
             stack.append((e["ts"] + e["dur"], e, False, len(stack)))
         flush_until(float("inf"))
     out.sort(key=lambda d: d.ts)
-    return out
+    dropped.sort(key=lambda d: d.ts)
+    return out, dropped
+
+
+def device_leaf_events(trace_dir: str) -> List[DeviceEvent]:
+    """Innermost (childless) events on device tracks.
+
+    Depth-1 attribution (:func:`device_op_events`) is blind inside
+    control flow: a step structured as ``lax.scan`` loops shows up as
+    one opaque ``while`` op covering 80-90% of the program (measured
+    on the round-5 production-shape LM step). Leaf events descend to
+    the ops the device actually ran — and, like depth-1, they cannot
+    double-count: no leaf contains another event. Childless depth-0
+    events are dropped (never ops on traces following XLA's nesting
+    convention); :func:`op_category_breakdown` reports their total so
+    a trace violating that convention is visible, not silently
+    under-attributed.
+    """
+    return _leaf_and_dropped_events(trace_dir)[0]
 
 
 def op_category_breakdown(trace_dir: str, window=None,
@@ -252,17 +279,36 @@ def op_category_breakdown(trace_dir: str, window=None,
     ``leaves=True`` attributes innermost events instead of depth-1
     ops — required when the program wraps its work in ``lax.scan`` /
     ``while`` (pipeline ticks, chained steps), whose depth-1 view is
-    one opaque ``while`` op.
+    one opaque ``while`` op. In this mode the result also carries a
+    reserved ``"dropped_unnested"`` entry (same seconds/count/top
+    shape, NOT an op category) whenever childless depth-0 events were
+    excluded from the attribution — on a conforming trace that is the
+    program-mirror span + async transfer rows, but on a trace
+    violating the "ops are always nested" assumption it is real op
+    time, and hiding it would make the program read faster than the
+    device ran it.
     """
-    evs = device_leaf_events(trace_dir) if leaves \
-        else device_op_events(trace_dir)
-    if not evs:
+    dropped: List[DeviceEvent] = []
+    if leaves:
+        evs, dropped = _leaf_and_dropped_events(trace_dir)
+    else:
+        evs = device_op_events(trace_dir)
+    if not evs and not dropped:
         return {}
-    pid0 = min(e.pid for e in evs)
-    evs = [e for e in evs if e.pid == pid0]
-    if window is not None:
-        t0, t1 = window
-        evs = [e for e in evs if t0 <= e.ts and e.ts + e.dur <= t1]
+    # pid0 from the leaves when any exist; a trace whose EVERY op row
+    # is unnested (the convention violation dropped_unnested exists to
+    # surface) must still report — falling back to the dropped rows'
+    # pid rather than returning {} and vanishing all device time.
+    pid0 = min(e.pid for e in (evs or dropped))
+
+    def clip(rows):
+        rows = [e for e in rows if e.pid == pid0]
+        if window is not None:
+            t0, t1 = window
+            rows = [e for e in rows if t0 <= e.ts and e.ts + e.dur <= t1]
+        return rows
+
+    evs = clip(evs)
     out: dict = {}
     per_name: dict = {}
     for e in evs:
@@ -279,7 +325,124 @@ def op_category_breakdown(trace_dir: str, window=None,
         )[:5]
         d["top"] = [(n, round(s, 9)) for n, s in tops]
         d["seconds"] = round(d["seconds"], 9)
+    dropped = clip(dropped)
+    if dropped:
+        by_name: dict = {}
+        for e in dropped:
+            by_name[e.name] = by_name.get(e.name, 0.0) + e.dur
+        tops = sorted(by_name.items(), key=lambda kv: -kv[1])[:5]
+        out["dropped_unnested"] = {
+            "seconds": round(sum(e.dur for e in dropped), 9),
+            "count": len(dropped),
+            "top": [(n, round(s, 9)) for n, s in tops],
+        }
     return out
+
+
+def _interval_union(intervals):
+    """Merge ``[(t0, t1), ...]`` into a sorted disjoint union."""
+    out = []
+    for t0, t1 in sorted(intervals):
+        if out and t0 <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], t1))
+        else:
+            out.append((t0, t1))
+    return out
+
+
+def _union_len(u) -> float:
+    return sum(t1 - t0 for t0, t1 in u)
+
+
+def _intersect_len(a, b) -> float:
+    """Total length of the intersection of two disjoint sorted unions."""
+    total, i, j = 0.0, 0, 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def gather_overlap_fraction(trace_dir: str,
+                            names: tuple = ("all-gather",),
+                            window=None) -> Optional[dict]:
+    """Fraction of device all-gather time hidden under concurrent
+    compute, from one ``jax.profiler.trace`` capture — the FSDP
+    prefetch metric (``bench.py``'s ``fsdp_overlap_frac``), measured
+    off the device timeline the same way ``flagship_large_mfu``'s
+    step time is.
+
+    Collective intervals: every device-track event whose name contains
+    one of ``names``; XLA's async pairs (``all-gather-start.N`` /
+    ``all-gather-done.N``) are bridged into one interval spanning
+    start-begin → done-end, because the in-flight gap between them IS
+    the transfer this metric asks about. Compute intervals: the leaf
+    events (:func:`device_leaf_events`) of every non-collective
+    category. Both sides are clipped to the lowest device pid (the
+    multi-track convention of :func:`differential_from_trace`) and the
+    optional ``(t0, t1)`` ``window``, merged into disjoint unions, and
+
+        frac = |gather ∩ compute| / |gather|
+
+    → ``{"frac", "gather_s", "hidden_s", "compute_s"}``; ``frac`` is
+    ``None`` when the trace holds no matching collective (nothing to
+    hide — a dp=1 mesh, or FSDP off). Returns ``None`` entirely when
+    the platform records no device track (the simulated CPU mesh).
+    """
+    xs, pid_names = load_trace_events(trace_dir)
+    dev_pids = {p for p, n in pid_names.items()
+                if str(n).startswith("/device:")}
+    dev_evs = [e for e in xs if e.get("pid") in dev_pids]
+    if not dev_evs:
+        return None
+    pid0 = min(e["pid"] for e in dev_evs)
+
+    def in_window(t0, t1):
+        return window is None or (window[0] <= t0 and t1 <= window[1])
+
+    def is_gather(name: str) -> bool:
+        low = name.lower()
+        return any(s in low for s in names)
+
+    starts, gathers = {}, []
+    # ts-sorted so an async pair's start is always seen before its
+    # done (Chrome-trace event order is not guaranteed).
+    for e in sorted(dev_evs, key=lambda e: e["ts"]):
+        if e["pid"] != pid0 or not is_gather(e.get("name", "")):
+            continue
+        name = e["name"]
+        t0, t1 = e["ts"] / 1e6, (e["ts"] + e["dur"]) / 1e6
+        if "start" in name:
+            starts[name.replace("start", "done")] = (t0, t1)
+        elif name in starts:  # bridge start → done into one interval
+            s0, _ = starts.pop(name)
+            gathers.append((s0, t1))
+        else:
+            gathers.append((t0, t1))
+    gathers.extend(starts.values())  # unpaired starts: own span only
+    gathers = [(t0, t1) for t0, t1 in gathers if in_window(t0, t1)]
+    leaves, _ = _leaf_and_dropped_events(trace_dir,
+                                         loaded=(xs, pid_names))
+    compute = [
+        (e.ts, e.ts + e.dur) for e in leaves
+        if e.pid == pid0 and categorize_op(e.name) != "collective"
+        and in_window(e.ts, e.ts + e.dur)
+    ]
+    gu, cu = _interval_union(gathers), _interval_union(compute)
+    gather_s = _union_len(gu)
+    hidden_s = _intersect_len(gu, cu)
+    return {
+        "frac": (hidden_s / gather_s) if gather_s > 0 else None,
+        "gather_s": gather_s,
+        "hidden_s": hidden_s,
+        "compute_s": _union_len(cu),
+    }
 
 
 def differential_from_trace(trace_dir: str, n_short: int, n_long: int,

@@ -34,7 +34,16 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
     # sp_strategy is validated by FlagshipConfig.__post_init__.
     if model_cfg is None and cfg.dtype in ("bfloat16", "float32"):
         mc = dataclasses.replace(mc, dtype=cfg.dtype)
-    params = F.place_flagship_params(F.init_flagship_params(mc), mesh)
+    if model_cfg is None and (cfg.zero_dp or cfg.overlap != "none"):
+        # --zero-dp [--overlap prefetch]: FSDP storage with the chosen
+        # gather schedule (prefetch = the double-buffered per-layer
+        # all-gather of tpu_p2p/parallel/fsdp.py).
+        mc = dataclasses.replace(mc, zero_dp=True, overlap=cfg.overlap)
+    # mc as the placement cfg: with zero_dp the param specs carry the
+    # ZeRO dp dim, and placing without it would materialize full
+    # replicas (the memory ZeRO exists to avoid) + a first-step
+    # reshard.
+    params = F.place_flagship_params(F.init_flagship_params(mc), mesh, mc)
     x, t = F.flagship_example_batch(mc, mesh)
     step = F.make_flagship_train_step(mesh, mc)
 
