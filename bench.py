@@ -74,6 +74,9 @@ HEADLINE_KEYS = (
     "fsdp_overlap_frac",
     "fsdp_step_ms_overlap_none",
     "fsdp_step_ms_overlap_prefetch",
+    "tp_overlap_frac",
+    "tp_step_ms_overlap_none",
+    "tp_step_ms_overlap_ring",
     "flagship_step_ms",
     "decode_ms_per_token",
     "decode_hbm_ms_per_token",
@@ -551,6 +554,114 @@ def _fsdp_overlap_metrics(timing):
         raise RuntimeError(
             f"fsdp overlap loss divergence: none={losses['none']} "
             f"prefetch={losses['prefetch']}"
+        )
+    return out
+
+
+# Null shape of _tp_overlap_metrics — failure must produce the same
+# keys (schema stability, mirroring FSDP_NULL).
+TP_NULL = {
+    "tp_devices": None,
+    "tp_step_ms_overlap_none": None,
+    "tp_step_ms_overlap_ring": None,
+    "tp_overlap_frac": None,
+    "tp_permute_ms": None,
+    "tp_source": None,
+}
+
+
+def _tp_overlap_metrics(timing):
+    """Ring collective-matmul Megatron joins (round 7 tentpole): the
+    flagship dense-FFN step under ``tp_overlap="none"`` vs ``"ring"``
+    on a pure-tp mesh over every visible device, plus the device-trace
+    overlap fraction — the share of collective-permute time hidden
+    under concurrent compute (:func:`tpu_p2p.utils.profiling.
+    tp_overlap_fraction`).
+
+    On a single chip tp=1, the ring degrades to the byte-identical
+    psum path — equal step times are the pass criterion there, and
+    ``tp_overlap_frac`` is null (no transfer exists to hide). On a
+    multi-device mesh the two step times are the before/after for the
+    decomposition and the fraction should be > 0 on hardware with a
+    device track.
+    """
+    import functools
+    import math
+    import tempfile
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.utils.profiling import tp_overlap_fraction
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.asarray(devs).reshape(n), ("tp",))
+    out = dict(TP_NULL)
+    out["tp_devices"] = n
+    losses = {}
+    for mode in ("none", "ring"):
+        cfg = F.FlagshipConfig(
+            # heads scale with the mesh so the Megatron shard always
+            # divides; the join payload [B, T, Dm] grows with n like a
+            # real tp config's would.
+            batch=2, seq=128, heads=2 * n, head_dim=32, stages=2,
+            microbatches=1, dense_ffn=True, moe_mult=2,
+            dtype="float32", tp_overlap=mode,
+        )
+        params = F.place_flagship_params(
+            F.init_flagship_params(cfg), mesh, cfg
+        )
+        x, t = F.flagship_example_batch(cfg, mesh)
+        step = F.make_flagship_train_step(mesh, cfg, lr=1e-2)
+        losses[mode] = float(step(params, x, t)[1])
+        if not math.isfinite(losses[mode]):
+            raise RuntimeError(f"tp_overlap={mode} loss non-finite")
+
+        @functools.lru_cache(maxsize=None)
+        def make_chain(k, step=step, x=x, t=t):
+            @jax.jit
+            def f(p):
+                def body(p, _):
+                    p2, loss = step(p, x, t)
+                    return p2, loss
+
+                return jax.lax.scan(body, p, None, length=k)[1]
+
+            return f
+
+        m = _measure(timing, make_chain, params, 8, repeats=2)
+        if m.per_op_s is None:
+            raise RuntimeError(
+                f"tp_overlap={mode} slope was not positive"
+            )
+        out[f"tp_step_ms_overlap_{mode}"] = round(m.per_op_s * 1e3, 3)
+        out["tp_source"] = m.source
+        if mode == "ring":
+            # One traced step for the overlap fraction (null on
+            # platforms recording no device track).
+            with tempfile.TemporaryDirectory(prefix="tp_ov_") as td:
+                with jax.profiler.trace(td):
+                    jax.block_until_ready(step(params, x, t))
+                ov = tp_overlap_fraction(td)
+            if ov is not None:
+                out["tp_overlap_frac"] = (
+                    round(ov["frac"], 4) if ov["frac"] is not None
+                    else None
+                )
+                out["tp_permute_ms"] = round(ov["gather_s"] * 1e3, 4)
+    # Numerical honesty, as for the FSDP pair: the two schedules
+    # compute the same math (ring reassociates the join sums); a real
+    # divergence means the ring path is broken and its step time must
+    # not publish (parity is pinned structurally in
+    # tests/test_tp_overlap.py).
+    ref = abs(losses["none"]) or 1.0
+    if abs(losses["none"] - losses["ring"]) > 0.05 * ref:
+        raise RuntimeError(
+            f"tp_overlap loss divergence: none={losses['none']} "
+            f"ring={losses['ring']}"
         )
     return out
 
@@ -1342,6 +1453,14 @@ def main() -> int:
         print(f"# fsdp overlap measurement failed: {e!r}", file=sys.stderr)
         fsdp_m = {}
     result["detail"].update({k: fsdp_m.get(k) for k in FSDP_NULL})
+    # Ring collective-matmul tp-join metrics (round-7 tentpole), same
+    # both-branch + degrade-to-baseline contract on a pure-tp mesh.
+    try:
+        tp_m = _tp_overlap_metrics(timing)
+    except Exception as e:  # noqa: BLE001 — same rationale
+        print(f"# tp overlap measurement failed: {e!r}", file=sys.stderr)
+        tp_m = {}
+    result["detail"].update({k: tp_m.get(k) for k in TP_NULL})
 
     detail_path = _detail_path()
     try:

@@ -32,6 +32,17 @@ os.environ.setdefault("BENCH_SWEEP_CAP_BYTES", str(2 * 1024 * 1024))
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # Tier-1 verify runs `-m 'not slow'` under a hard wall clock
+    # (ROADMAP.md: 870 s); the full suite outgrew that budget, so the
+    # heaviest tests carry this marker and run only in uncapped full
+    # passes (`pytest tests/ -m slow`, or no -m filter at all).
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy tests excluded from the tier-1 timed run",
+    )
+
+
 @pytest.fixture(scope="session")
 def rt():
     """A validated 8-device runtime on the simulated CPU mesh."""

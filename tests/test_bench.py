@@ -269,6 +269,13 @@ def test_latency_8b_device_nonpositive_escalates_then_falls_back():
 # ---------------------------------------------------- multi-chip branch
 
 
+@pytest.mark.slow  # tier-1 budget (round 7): this is the suite's
+# single heaviest test (~190 s — real 32 MiB pair chains + the
+# 4096/16384/65536-op latency-escalation compiles on the CPU mesh).
+# The multichip main() wiring stays tier-1-covered by the stubbed-
+# measure twins (bad_env_falls_back, device_sourced_cells); the real
+# measurement path runs in uncapped full passes and on the graded
+# TPU bench itself.
 def test_main_multichip_branch_schema(capsys, monkeypatch, tmp_path):
     # The visible pytest mesh is 8 simulated CPU devices, so main()
     # takes the n >= 2 branch — the reference-workload path that had
@@ -285,15 +292,21 @@ def test_main_multichip_branch_schema(capsys, monkeypatch, tmp_path):
         bench, "_fsdp_overlap_metrics",
         lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
     )
+    monkeypatch.setattr(
+        bench, "_tp_overlap_metrics",
+        lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
+    )
     compact, r = _run_main(capsys, monkeypatch, tmp_path)
     assert compact["metric"] == r["metric"]
     assert compact["value"] == r["value"]
     assert compact["n"] == 8
     assert compact["headline"]["pairs_measured"] == 3
     assert r["metric"] == "all_pairs_unidir_bandwidth_avg"
-    # Stubbed-failure FSDP metrics degrade to explicit nulls.
+    # Stubbed-failure FSDP/tp-overlap metrics degrade to explicit nulls.
     assert r["detail"]["fsdp_overlap_frac"] is None
     assert r["detail"]["fsdp_step_ms_overlap_prefetch"] is None
+    assert r["detail"]["tp_overlap_frac"] is None
+    assert r["detail"]["tp_step_ms_overlap_ring"] is None
     assert r["unit"] == "Gbps"
     assert r["value"] > 0 and math.isfinite(r["value"])
     # vs_baseline is rounded to 4 decimals; at CPU-mesh speeds the
@@ -356,6 +369,7 @@ def test_main_multichip_bad_env_falls_back(capsys, monkeypatch, tmp_path):
         bench, "_latency_8b", lambda *a, **kw: {"latency_8b_p50_us": None}
     )
     monkeypatch.setattr(bench, "_fsdp_overlap_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_tp_overlap_metrics", lambda t: {})
     _, r = _run_main(capsys, monkeypatch, tmp_path)
     # Fell back to the default 24-pair cap: ceil-stride over the 56
     # ordered pairs of an 8-device mesh measures 19 of them.
@@ -376,6 +390,7 @@ def test_main_multichip_device_sourced_cells(capsys, monkeypatch,
         bench, "_latency_8b", lambda *a, **kw: {"latency_8b_p50_us": None}
     )
     monkeypatch.setattr(bench, "_fsdp_overlap_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_tp_overlap_metrics", lambda t: {})
     _, r = _run_main(capsys, monkeypatch, tmp_path)
     d = r["detail"]
     assert d["headline_source"] == "device_trace"
@@ -414,6 +429,10 @@ def test_sweep_cap_filters_ladder(monkeypatch):
     )
 
 
+@pytest.mark.slow  # tier-1 budget (~45 s: real loopback rewrites +
+# latency escalation on 1 CPU device); the single-chip main() wiring
+# stays tier-1-covered by test_single_chip_headline_vs_baseline_
+# uses_device_kind (stubbed measure, same code path)
 def test_main_single_chip_branch_schema(capsys, monkeypatch, tmp_path):
     import tpu_p2p.parallel.runtime as rtmod
 
@@ -451,6 +470,10 @@ def test_main_single_chip_branch_schema(capsys, monkeypatch, tmp_path):
     )
     monkeypatch.setattr(
         bench, "_fsdp_overlap_metrics",
+        lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
+    )
+    monkeypatch.setattr(
+        bench, "_tp_overlap_metrics",
         lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
     )
     detail_path = os.path.join(str(tmp_path), "BENCH_detail.json")
@@ -506,6 +529,10 @@ def test_main_single_chip_branch_schema(capsys, monkeypatch, tmp_path):
     assert d["fsdp_overlap_frac"] is None
     assert d["fsdp_step_ms_overlap_none"] is None
     assert d["fsdp_step_ms_overlap_prefetch"] is None
+    # And the round-7 tp ring-overlap entries.
+    assert d["tp_overlap_frac"] is None
+    assert d["tp_step_ms_overlap_none"] is None
+    assert d["tp_step_ms_overlap_ring"] is None
     assert "stubbed" in cap.err
     # Latency: a real (cheap, 8-byte) measurement ran — either shape —
     # and every latency dict is discriminated by kind so same-named
@@ -569,6 +596,7 @@ def test_single_chip_headline_vs_baseline_uses_device_kind(capsys,
                         lambda t, p: {})
     monkeypatch.setattr(bench, "_decode_hbm_metrics", lambda t, p: {})
     monkeypatch.setattr(bench, "_fsdp_overlap_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_tp_overlap_metrics", lambda t: {})
     monkeypatch.setattr(
         bench, "_loopback_size_sweep", lambda *a, **kw: [])
     _, r = _run_main(capsys, monkeypatch, tmp_path)
@@ -657,3 +685,75 @@ def test_fsdp_overlap_metrics_cpu_mesh(monkeypatch):
     assert out["fsdp_source"] == "host_differential"
     assert out["fsdp_overlap_frac"] is None  # CPU: no device track
     assert set(out) == set(bench.FSDP_NULL)
+
+
+def test_tp_overlap_metrics_cpu_mesh(monkeypatch):
+    # The tp twin of test_fsdp_overlap_metrics_cpu_mesh: both modes
+    # build + run a real tp=8 flagship step (the ring path's compile
+    # coverage on the full visible mesh), the losses agree, and the
+    # schema comes back filled. CPU records no device track, so the
+    # overlap fraction is an explicit null with the step times present.
+    from tpu_p2p.utils import timing
+
+    monkeypatch.setattr(
+        bench, "_measure",
+        lambda t, mc, x, iters, repeats=3, runs=2:
+            _fake_headline(host=2e-3),
+    )
+    out = bench._tp_overlap_metrics(timing)
+    assert out["tp_devices"] == 8
+    assert out["tp_step_ms_overlap_none"] == pytest.approx(2.0)
+    assert out["tp_step_ms_overlap_ring"] == pytest.approx(2.0)
+    assert out["tp_source"] == "host_differential"
+    assert out["tp_overlap_frac"] is None  # CPU: no device track
+    assert set(out) == set(bench.TP_NULL)
+
+
+def test_compact_line_fits_with_every_headline_key_at_realistic_width():
+    # Satellite contract (round 7): the ≤1 KiB budget must hold with
+    # ALL headline keys present at realistic numeric widths — i.e. the
+    # compact line drops NOTHING on a fully-populated round. The
+    # round-5 failure mode was exactly keys accumulating round-over-
+    # round until the tail overflowed; this pins the full-schema line
+    # (including every tp_overlap_* and fsdp_* key) inside the budget
+    # WITHOUT relying on the drop-from-the-end fallback.
+    realistic = {
+        "devices": 256,
+        "headline_source": "device_trace",
+        "hbm_gbytes_per_s": 657.13,
+        "flash_attention_tflops": 140.9,
+        "flash_bwd_tflops": 108.7,
+        "flagship_large_step_ms": 360.33,
+        "flagship_large_mfu": 0.7134,
+        "latency_8b_p50_us": 1.2345,
+        "latency_8b_oneop_p50_us": 23.456,
+        "fsdp_overlap_frac": 0.8231,
+        "fsdp_step_ms_overlap_none": 123.456,
+        "fsdp_step_ms_overlap_prefetch": 98.765,
+        "tp_overlap_frac": 0.7654,
+        "tp_step_ms_overlap_none": 123.456,
+        "tp_step_ms_overlap_ring": 98.765,
+        "flagship_step_ms": 5.96,
+        "decode_ms_per_token": 0.123,
+        "decode_hbm_ms_per_token": 0.0419,
+        "flagship_large_tokens_per_s": 45467,
+        "pairs_measured": 24,
+        "min_gbps": 123.456,
+        "max_gbps": 1234.567,
+    }
+    # Every headline key must have a realistic value in this test —
+    # a key added to HEADLINE_KEYS without extending this table would
+    # silently shrink the coverage the budget pin provides.
+    assert set(realistic) == set(bench.HEADLINE_KEYS)
+    result = {
+        "metric": "all_pairs_unidir_bandwidth_avg",
+        "value": 1234.567,
+        "unit": "Gbps",
+        "vs_baseline": 0.7716,
+        "detail": realistic,
+    }
+    s = bench._compact_line(result, "BENCH_detail.json")
+    assert len(s.encode()) <= bench.COMPACT_LINE_MAX_BYTES
+    r = json.loads(s)
+    # NOTHING was dropped: the full schema rides the line.
+    assert set(r["headline"]) == set(bench.HEADLINE_KEYS)

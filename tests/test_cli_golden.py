@@ -37,6 +37,14 @@ SUMMARY_PATTERNS = {
                 "--iters", "4"],
     "allreduce": ["--cpu-mesh", "8", "--pattern", "allreduce",
                   "--iters", "2", "--msg-size", "64KiB"],
+    # The round-7 tp_overlap knob end to end: the flagship_step line
+    # must carry the active mode (build_mesh lands tp=1 on 8 devices,
+    # where ring degrades to the psum path by contract — the pin is
+    # the knob's plumbing + output contract, not a tp>1 measurement,
+    # which tests/test_tp_overlap.py covers on explicit tp meshes).
+    "flagship_tp_ring": ["--cpu-mesh", "8", "--pattern",
+                         "flagship_step", "--tp-overlap", "ring",
+                         "--iters", "2"],
 }
 
 _FIELD = re.compile(r" *\d+\.\d\d")  # a whole padded %6.02f field
@@ -80,12 +88,18 @@ def mask(text: str) -> str:
 
 _ANY_FLOAT = re.compile(r"\d+\.\d+")  # any decimal count (p50 lines
 # print one decimal, Gbps fields two)
+_TOKENS_RATE = re.compile(r"[\d,]+ tokens/s")  # the flagship
+# tokens/s magnitude (comma-grouped int, no decimals) — masked by its
+# unit so structural ints (sizes, device counts, mesh axes) elsewhere
+# stay pinned at any magnitude
 
 
 def mask_floats(text: str) -> str:
-    """Collapse every float to ``####``: the summary-line contract is
-    labels + units + structure, not CPU-speed magnitudes."""
-    return _ANY_FLOAT.sub("####", text)
+    """Collapse every float (and the tokens/s rate) to ``####``: the
+    summary-line contract is labels + units + structure, not
+    CPU-speed magnitudes."""
+    return _TOKENS_RATE.sub("#### tokens/s",
+                            _ANY_FLOAT.sub("####", text))
 
 
 def _run_cli(args=ARGS) -> str:

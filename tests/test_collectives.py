@@ -346,3 +346,143 @@ def test_bucketed_ag_chain_matches_host_oracle(rt, cache):
 def test_bucketed_ag_chain_rejects_indivisible_split(rt, cache):
     with pytest.raises(ValueError, match="not divisible"):
         cache.bucketed_ag_chain(rt.mesh, "d", (3, 5), 1)
+
+
+# ------------------------------------------- ring collective-matmul
+
+
+def _sm(mesh, f, in_specs, out_specs):
+    import jax
+
+    return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs))
+
+
+def test_ring_allgather_matmul_matches_gather_then_matmul(rt):
+    # The overlapped decomposition must be *semantically* a tiled
+    # all-gather followed by the matmul (Wang et al. ASPLOS'23).
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.default_rng(0)
+    xg = rng.standard_normal((16, 6)).astype(np.float32)  # [t, k]
+    w = jnp.asarray(rng.standard_normal((6, 5)).astype(np.float32))
+
+    def f(x):
+        return C.ring_allgather_matmul(
+            lambda c, _s: jnp.einsum("tk,kf->tf", c, w),
+            x, "d", gather_dim=0)
+
+    got = _sm(rt.mesh, f, P("d", None), P(None, None))(xg)
+    np.testing.assert_allclose(np.asarray(got), xg @ np.asarray(w),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ring_allgather_matmul_passes_source_index(rt):
+    # compute_chunk(chunk, src) sees the chunk's ring origin — the
+    # hook the flagship join uses to slice replicated residuals
+    # locally. Output chunk s must equal src-tagged input chunk s.
+    from jax.sharding import PartitionSpec as P
+
+    xg = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+    def f(x):
+        return C.ring_allgather_matmul(
+            lambda c, s: c + 100.0 * s, x, "d", gather_dim=0)
+
+    got = np.asarray(_sm(rt.mesh, f, P("d", None), P(None, None))(xg))
+    want = xg + 100.0 * np.arange(8, dtype=np.float32)[:, None]
+    np.testing.assert_allclose(got, want)
+
+
+def test_matmul_ring_reducescatter_matches_psum_then_slice(rt):
+    # Each rank holds a k-shard of the lhs (the Megatron partial
+    # operand); the ring must deliver rank i chunk i of the full sum.
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.default_rng(1)
+    xg = rng.standard_normal((16, 8)).astype(np.float32)   # [t, k]
+    wg = rng.standard_normal((8, 4)).astype(np.float32)    # [k, f]
+
+    def f(xloc, wloc):
+        return C.matmul_ring_reducescatter(
+            lambda c, _i: jnp.einsum("tk,kf->tf", c, wloc),
+            xloc, "d", chunk_dim=0)
+
+    got = _sm(rt.mesh, f, (P(None, "d"), P("d", None)),
+              P("d", None))(xg, wg)
+    np.testing.assert_allclose(np.asarray(got), xg @ wg,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_matmul_ring_reducescatter_rejects_indivisible_chunks(rt):
+    from jax.sharding import PartitionSpec as P
+
+    xg = np.ones((10, 8), np.float32)  # 10 % 8 != 0
+
+    def f(x):
+        return C.matmul_ring_reducescatter(
+            lambda c, _i: c, x, "d", chunk_dim=0)
+
+    with pytest.raises(ValueError, match="pad before the ring"):
+        _sm(rt.mesh, f, P(None, None), P("d", None))(xg)
+
+
+def test_tp_ring_chain_shape_preserving_and_cached(rt, cache):
+    # One hop = ag-matmul + matmul-RS with identity weights: each
+    # rank's chunk comes back scaled by the axis size (the RS sums n
+    # copies of its own chunk) — shape-preserving, so it scans.
+    x = C.make_payload(rt.mesh, 512, jnp.int8)
+    before = len(cache)
+    fn = cache.tp_ring_chain(rt.mesh, "d", 2)
+    assert len(cache) == before + 1
+    y = fn(x)
+    assert y.shape == x.shape and y.dtype == x.dtype
+    # int8 wraparound: 2 hops scale by 8^2 = 64 exactly (mod 256).
+    np.testing.assert_array_equal(
+        np.asarray(y), (np.asarray(x).astype(np.int32) * 64).astype(np.int8)
+    )
+    assert cache.tp_ring_chain(rt.mesh, "d", 2) is fn  # cache hit
+
+
+# --------------------------------------------------- cache LRU bound
+
+
+def test_cache_lru_evicts_and_rebuilds(rt):
+    built = []
+
+    class Counting(C.CollectiveCache):
+        def _get(self, key, builder):
+            def counting_builder():
+                built.append(key)
+                return builder()
+
+            return super()._get(key, counting_builder)
+
+    cache = Counting(maxsize=2)
+    e01, e12, e23 = ([(0, 1)], [(1, 2)], [(2, 3)])
+    f01 = cache.permute(rt.mesh, "d", e01)
+    f12 = cache.permute(rt.mesh, "d", e12)
+    assert len(cache) == 2 and len(built) == 2
+    # Touch e01 (now MRU), then insert a third: e12 is the LRU victim.
+    assert cache.permute(rt.mesh, "d", e01) is f01
+    cache.permute(rt.mesh, "d", e23)
+    assert len(cache) == 2
+    s = cache.stats()
+    assert s["evictions"] == 1 and s["hits"] == 1 and s["misses"] == 3
+    # The evicted entry transparently recompiles — and still computes
+    # the right permutation (eviction is a memory trade, never a
+    # correctness event).
+    f12b = cache.permute(rt.mesh, "d", e12)
+    assert f12b is not f12 and len(built) == 4
+    x = C.make_payload(rt.mesh, 64, jnp.int8)
+    y = np.asarray(f12b(x))
+    np.testing.assert_array_equal(
+        y, C.expected_permute(np.asarray(x), [(1, 2)])
+    )
+
+
+def test_cache_default_is_bounded():
+    c = C.CollectiveCache()
+    assert c.stats()["maxsize"] == C.CollectiveCache.DEFAULT_MAXSIZE
+    with pytest.raises(ValueError, match="maxsize"):
+        C.CollectiveCache(maxsize=0)

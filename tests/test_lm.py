@@ -81,6 +81,9 @@ def test_lm_training_decreases_ce():
     assert losses[0] == pytest.approx(np.log(cfg.vocab), rel=0.3)
 
 
+@pytest.mark.slow  # tier-1 budget (~13 s): ZeRO storage/parity stays
+# tier-1-covered by tests/test_fsdp.py; this adds the LM-embedding
+# sharding specifics on top
 def test_lm_zero_dp_shards_embedding():
     cfg = _cfg(zero_dp=True)
     mesh = _mesh(dp=4)

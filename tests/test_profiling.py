@@ -600,3 +600,40 @@ def test_all_unnested_trace_still_reports_dropped(tmp_path):
     assert list(got) == ["dropped_unnested"]
     assert got["dropped_unnested"]["count"] == 2
     assert got["dropped_unnested"]["seconds"] == pytest.approx(60e-6)
+
+
+def test_tp_overlap_fraction_tracks_collective_permute(tmp_path):
+    # The tp_overlap="ring" twin of gather_overlap_fraction: bridges a
+    # collective-permute-start/-done pair and measures the compute
+    # hidden under it — while IGNORING all-gather events (those belong
+    # to the FSDP metric) and excluding the join's psum combine from
+    # the compute side (collectives never count as "compute").
+    events = [
+        _meta(3, "/device:TPU:0"),
+        _ev(3, 1, "jit_step(1)", 90.0, 320.0),
+        _ev(3, 1, "collective-permute-start.7", 100.0, 10.0),
+        _ev(3, 1, "fusion.1", 120.0, 60.0),
+        _ev(3, 1, "collective-permute-done.7", 195.0, 5.0),
+        # An all-gather in the same window: the FSDP metric's op, not
+        # this one's — it must not widen the permute interval (it DOES
+        # count as a collective, so it is not compute either).
+        _ev(3, 1, "all-gather.9", 210.0, 40.0),
+        _ev(3, 1, "all-reduce.2", 260.0, 30.0),
+    ]
+    ov = P.tp_overlap_fraction(_write_trace(tmp_path, events))
+    assert ov["gather_s"] == pytest.approx(100e-6)  # bridged 100->200
+    assert ov["hidden_s"] == pytest.approx(60e-6)
+    assert ov["frac"] == pytest.approx(0.6)
+    assert ov["compute_s"] == pytest.approx(60e-6)
+
+
+def test_tp_overlap_fraction_null_without_permutes(tmp_path):
+    # tp=1 (or ring off): no collective-permute in the capture ->
+    # frac None, same contract as the FSDP metric on a dp=1 mesh.
+    events = [
+        _meta(3, "/device:TPU:0"),
+        _ev(3, 1, "jit_step(1)", 90.0, 220.0),
+        _ev(3, 1, "fusion.1", 120.0, 60.0),
+    ]
+    ov = P.tp_overlap_fraction(_write_trace(tmp_path, events))
+    assert ov["frac"] is None and ov["gather_s"] == 0.0

@@ -6,6 +6,7 @@ import dataclasses
 
 import jax
 import numpy as np
+import pytest
 
 from tpu_p2p.models import flagship as F
 
@@ -84,6 +85,9 @@ def test_remat_composes_with_ring_flash():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # tier-1 budget (~7 s): donation rides every
+# trainer-loop test (donate=True there); the bit-exactness pin runs
+# in uncapped full passes
 def test_donated_step_matches_plain_step():
     mesh = F.build_mesh(8)
     cfg = _cfg()

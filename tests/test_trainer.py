@@ -7,6 +7,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 from tpu_p2p.models import flagship as F
 from tpu_p2p.train import run_training
@@ -89,6 +90,9 @@ def test_mismatched_checkpoint_rejected(tmp_path):
                      log_every=0, ckpt_dir=ck, resume=True)
 
 
+@pytest.mark.slow  # tier-1 budget (~11 s: three multi-step optax
+# runs); the core resume contract stays tier-1 via
+# test_resume_is_bit_exact
 def test_adamw_resume_is_bit_exact(tmp_path):
     # Resume must restore the optimizer moments, not just the params —
     # a moment-less resume diverges from the uninterrupted run.
@@ -123,6 +127,8 @@ def test_adamw_resume_from_sgd_checkpoint_rejected(tmp_path):
                      optimizer="adamw", ckpt_dir=ck, resume=True)
 
 
+@pytest.mark.slow  # tier-1 budget (~15 s): same resume contract,
+# schedule/clip variant
 def test_hygiene_resume_is_bit_exact(tmp_path):
     # clip + warmup route sgd through optax; the schedule count lives
     # in the checkpointed opt state, so an interrupted run must resume
@@ -152,6 +158,8 @@ def test_cosine_schedule_trains():
     assert np.isfinite(out["final_loss"])
 
 
+@pytest.mark.slow  # tier-1 budget (~8 s): two 3-step optax runs;
+# the optax plumbing stays tier-1 via the checkpoint tests
 def test_clipping_changes_the_trajectory():
     # Optax path on BOTH sides (huge cap vs tiny cap), so the only
     # difference is whether the clip bites — comparing against the
@@ -168,6 +176,8 @@ def test_clipping_changes_the_trajectory():
     assert clipped["final_loss"] > uncapped["final_loss"]
 
 
+@pytest.mark.slow  # tier-1 budget (~12 s): adamw run + two sgd
+# runs; the dir-reuse guard logic is pure-Python around them
 def test_sgd_resume_after_dir_reuse(tmp_path):
     # An adamw run leaves opt_state.npz; a later plain-sgd run reusing
     # the dir must clear it, so its own resume works.

@@ -115,6 +115,9 @@ def test_zigzag_rejects_odd_local_length():
         fn(q2, k2, v2)
 
 
+@pytest.mark.slow  # tier-1 budget (~10 s): the zigzag layout/oracle
+# math stays tier-1-covered by this file's other tests; this is the
+# full-flagship composition variant
 def test_flagship_ring_zigzag_strategy():
     # The flagship treats its sequence axis as zigzag-ordered: the
     # forward on zigzag-permuted data must equal the contiguous-ring

@@ -99,6 +99,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="flagship_step + --zero-dp: FSDP gather schedule "
                         "(prefetch = double-buffered per-layer all-gather "
                         "overlapped with compute)")
+    p.add_argument("--tp-overlap", choices=("none", "ring"),
+                   default="none",
+                   help="flagship_step: Megatron tp-join schedule (ring "
+                        "= ppermute collective-matmul decomposition, "
+                        "per-chunk transfers overlapped with the matmuls;"
+                        " no-op at tp=1)")
     p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
                    help="testing: force CPU platform with N simulated devices")
     p.add_argument("--list-devices", action="store_true",
@@ -137,6 +143,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         attn_window=args.attn_window,
         zero_dp=args.zero_dp,
         overlap=args.overlap,
+        tp_overlap=args.tp_overlap,
     )
 
 

@@ -128,6 +128,13 @@ class BenchConfig:
     # ignore it.
     zero_dp: bool = False  # flagship_step: ZeRO-3/FSDP param sharding
     # over the dp axis (FlagshipConfig.zero_dp)
+    tp_overlap: str = "none"  # flagship_step: Megatron tp-join
+    # scheduling ("none" = blocking psum joins, "ring" = ppermute
+    # collective-matmul decomposition overlapping per-chunk transfers
+    # with the MXU); mirrors FlagshipConfig.tp_overlap, see
+    # tpu_p2p/parallel/collectives.py ring_allgather_matmul /
+    # matmul_ring_reducescatter. No-op at tp=1; other patterns
+    # ignore it.
 
     def __post_init__(self) -> None:
         if self.pattern not in PATTERNS:
@@ -148,6 +155,11 @@ class BenchConfig:
             raise ValueError(
                 f"unknown overlap {self.overlap!r}; expected 'none' "
                 "or 'prefetch'"
+            )
+        if self.tp_overlap not in ("none", "ring"):
+            raise ValueError(
+                f"unknown tp_overlap {self.tp_overlap!r}; expected "
+                "'none' or 'ring'"
             )
 
     @property

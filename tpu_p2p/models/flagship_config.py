@@ -68,6 +68,22 @@ class FlagshipConfig:
     # gradient reduce-scatters interleave symmetrically (the gather's
     # autodiff transpose). Loss/grads are numerically identical either
     # way (tests/test_fsdp.py); docs/fsdp_overlap.md has the schedule.
+    tp_overlap: str = "none"  # Megatron tp-join scheduling (only
+    # meaningful with a tp axis > 1):
+    # "none" — the attention out-projection and dense-FFN second
+    # matmul join their partial products with one blocking
+    # jax.lax.psum each (byte-identical baseline; the ICI all-reduce
+    # serializes against the MXU); "ring" — the collective-matmul
+    # decomposition (Wang et al. ASPLOS'23 / Pope et al. '22): each
+    # join unrolls into a shift-by-1 ppermute ring over token chunks
+    # (collectives.matmul_ring_reducescatter +
+    # collectives.ring_allgather_matmul), so per-chunk transfers
+    # overlap the neighboring chunks' matmuls and the backward gets
+    # the mirrored schedule through autodiff. Loss/grads agree to f32
+    # reassociation level (the ring fixes a different summation order
+    # than the fused all-reduce); tp=1 degrades to a no-op. Composes
+    # with overlap="prefetch" on dp×tp meshes (tests/test_tp_overlap).
+    # Schedule + when "none" wins: docs/tp_overlap.md.
     use_flash: bool = False  # Pallas flash kernel for the attention
     # math, trainable under every sp_strategy: Ulysses sees the full
     # sequence locally (the standalone custom-vjp kernel drops in);
@@ -132,6 +148,27 @@ class FlagshipConfig:
             raise ValueError(
                 f"unknown overlap {self.overlap!r}; expected 'none' "
                 "or 'prefetch'"
+            )
+        # prefetch schedules ZeRO gathers — without zero_dp there are
+        # no gathers at all, and the run would silently time the
+        # baseline while its logs claim overlap (the same silent-
+        # divergence class the strict string checks exist for). A
+        # 1-sized dp axis with zero_dp=True stays a legal no-op: that
+        # is a mesh property, knowable only at build time.
+        if self.overlap == "prefetch" and not self.zero_dp:
+            raise ValueError(
+                "overlap='prefetch' requires zero_dp=True (the "
+                "prefetch schedule is a ZeRO parameter-gather "
+                "schedule; without FSDP storage there is nothing to "
+                "prefetch)"
+            )
+        # Strict like overlap: a typo ("rings", "Ring") would silently
+        # train on the exposed-psum path while the run's logs claim the
+        # collective-matmul overlap.
+        if self.tp_overlap not in ("none", "ring"):
+            raise ValueError(
+                f"unknown tp_overlap {self.tp_overlap!r}; expected "
+                "'none' or 'ring'"
             )
         # Strict: a typo'd policy name must fail at config time, not
         # trace deep inside the step builder. hasattr alone is not

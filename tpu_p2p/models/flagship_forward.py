@@ -82,6 +82,13 @@ def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
         a = flash_attention(q, k, v, cfg.causal, window)
     else:
         a = dense_attention(q, k, v, causal=cfg.causal, window=window)
+    if (cfg.tp_overlap == "ring" and tp is not None
+            and jax.lax.axis_size(tp) > 1):
+        # Latency-hiding Megatron joins: both psums unroll into
+        # ppermute rings whose per-chunk transfers overlap the
+        # neighboring chunks' matmuls (docs/tp_overlap.md). tp=1 (or
+        # no tp axis) falls through to the byte-identical psum path.
+        return _tp_ring_join(sub_params, x, a, cfg, tp, ep)
     y = jnp.einsum("bhtd,hdm->btm", a, sub_params["wo"])
     if tp is not None:
         y = jax.lax.psum(y, tp)  # Megatron join of head shards
@@ -89,18 +96,18 @@ def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
     h2 = _rms_norm(x, sub_params["ln2"]) if cfg.norm else x
     if cfg.dense_ffn:
         return x + _dense_ffn(sub_params, h2, tp)
-    # MoE FFN over flattened local tokens.
-    moe_params = {k2: sub_params[k2] for k2 in ("router",)}
-    moe_params["w1"], moe_params["w2"] = sub_params["we1"], sub_params["we2"]
-    tokens = h2.reshape(-1, h2.shape[-1])
-    m_out = moe_layer_local(moe_params, tokens, cfg.moe(), ep_axis=ep)
-    return x + m_out.reshape(x.shape)
+    return x + _moe_ffn(sub_params, h2, cfg, ep)
 
 
 def _dense_ffn(sub_params: Params, h, tp):
     """Dense 2-layer gelu MLP, Megatron-sharded over ``tp``: wf1 holds
     a column (hidden) shard, wf2 the matching row shard, and one psum
-    joins the partial outputs. gelu(0) == 0 keeps bubbles inert."""
+    joins the partial outputs. gelu(0) == 0 keeps bubbles inert.
+
+    ``cfg.tp_overlap="ring"`` replaces this join (and the attention
+    psum) with the overlapped ring decomposition — see
+    :func:`_tp_ring_join`; this blocking-psum path is the
+    byte-identical ``"none"`` baseline."""
     f_h = jax.nn.gelu(jnp.einsum("btm,mf->btf", h, sub_params["wf1"],
                                  preferred_element_type=jnp.float32))
     f_out = jnp.einsum("btf,fm->btm", f_h, sub_params["wf2"],
@@ -108,6 +115,131 @@ def _dense_ffn(sub_params: Params, h, tp):
     if tp is not None:
         f_out = jax.lax.psum(f_out, tp)
     return f_out.astype(h.dtype)
+
+
+def _moe_ffn(sub_params: Params, h2, cfg: FlagshipConfig, ep):
+    """MoE FFN over flattened local tokens (shared by the psum and
+    ring block tails — the routed expert matmuls have no tp join)."""
+    moe_params = {k2: sub_params[k2] for k2 in ("router",)}
+    moe_params["w1"], moe_params["w2"] = sub_params["we1"], sub_params["we2"]
+    tokens = h2.reshape(-1, h2.shape[-1])
+    m_out = moe_layer_local(moe_params, tokens, cfg.moe(), ep_axis=ep)
+    return m_out.reshape(h2.shape)
+
+
+def _tp_ring_join(sub_params: Params, x, a, cfg: FlagshipConfig, tp, ep):
+    """``tp_overlap="ring"`` tail of a transformer block: both
+    Megatron joins via the ppermute collective-matmul decomposition
+    (docs/tp_overlap.md).
+
+    The baseline joins shards with bare blocking psums — the ICI
+    all-reduce fully exposed against the MXU. Here each join unrolls
+    over *token* chunks of the local sequence:
+
+    - attention out-projection → :func:`collectives.
+      matmul_ring_reducescatter` — per-chunk ``a @ wo`` partials are
+      emitted and ring-combined, leaving rank ``i`` with token chunk
+      ``i`` of the joined output (the psum's reduce-scatter half,
+      transfers hidden under the neighboring chunks' matmuls);
+    - dense FFN first matmul → :func:`collectives.
+      ring_allgather_matmul` — the still-token-sharded attention
+      *delta* is re-gathered THROUGH ``wf1`` (each arriving chunk's
+      matmul issues while the next chunk is in flight), fusing the
+      all-gather half of the attention join into the FFN's own
+      compute; each arriving delta chunk is combined with a locally
+      sliced chunk of the replicated residual (and pre-normed) inside
+      the per-chunk compute, so only the novel bytes ride the ring —
+      and every replicated operand (``x``, ``ln2``) is consumed for
+      ALL token chunks on every rank, keeping its cotangent exactly
+      baseline-shaped;
+    - dense FFN second matmul → a second ``matmul_ring_reducescatter``
+      with ``wf2``;
+    - one chunk-scatter + ``psum`` re-replicates the block's joined
+      *delta* onto the residual stream at block exit (MoE blocks
+      re-replicate right after the attention join — routing/capacity
+      must see the baseline's local token set).
+
+    The final combine is deliberately a psum of the token-scattered
+    delta, NOT an all-gather of the residual: the residual path then
+    stays rank-local and the joins all cross ``psum`` — exactly the
+    baseline's gradient-accounting structure (cotangents of
+    replicated values arrive once via the local path and summed via
+    the join transposes), and exactly the baseline's replication
+    typing (the block output is psum-typed unvarying over ``tp``, so
+    downstream specs/vma are unchanged). An all-gather combine would
+    route the residual's cotangent through a summing transpose the
+    psum baseline does not have — structurally different gradients,
+    not just reassociation (probed live: replicated-leaf grads drift
+    ~50% that way).
+
+    Non-divisible local sequence lengths pad the ring chunking: padded
+    (zero) tokens stay zero through every op (RMSNorm(0) == 0,
+    gelu(0) == 0, zero partial products — the pipeline-bubble
+    invariant) and are sliced off after the final combine. Everything
+    here is plain lax, so autodiff transposes the rings into the
+    mirrored backward schedule for free.
+    """
+    from tpu_p2p.parallel.collectives import (
+        matmul_ring_reducescatter,
+        ring_allgather_matmul,
+    )
+
+    n = jax.lax.axis_size(tp)
+    idx = jax.lax.axis_index(tp)
+    t_loc = x.shape[1]
+    t_pad = -(-t_loc // n) * n
+    if t_pad != t_loc:
+        x = jnp.pad(x, ((0, 0), (0, t_pad - t_loc), (0, 0)))
+        a = jnp.pad(a, ((0, 0), (0, 0), (0, t_pad - t_loc), (0, 0)))
+    ct = t_pad // n
+
+    def unshard(delta_chunk):
+        """chunk ``idx`` of a joined delta → the full [b, t_pad, m]
+        delta, replicated over ``tp`` (psum of the one-hot-chunk
+        scatter; see the combine note in the docstring)."""
+        from tpu_p2p.parallel.collectives import _promote_vma
+
+        # Fresh zeros are unvarying under vma-checked shard_map while
+        # the delta varies over tp — promote before the scatter, the
+        # same agreement ring_allgather_matmul's output buffer needs.
+        buf, delta_chunk = _promote_vma(
+            [jnp.zeros(x.shape, delta_chunk.dtype), delta_chunk])
+        buf = jax.lax.dynamic_update_slice_in_dim(buf, delta_chunk,
+                                                  idx * ct, 1)
+        return jax.lax.psum(buf, tp)
+
+    y_shard = matmul_ring_reducescatter(
+        lambda c, _s: jnp.einsum("bhtd,hdm->btm", c, sub_params["wo"]),
+        a, tp, chunk_dim=2)
+    if not cfg.dense_ffn:
+        x = (x + unshard(y_shard))[:, :t_loc]
+        h2 = _rms_norm(x, sub_params["ln2"]) if cfg.norm else x
+        return x + _moe_ffn(sub_params, h2, cfg, ep)
+
+    def ffn1_chunk(y_c, src):
+        # Only the attention-join delta rides the ring; the residual
+        # chunk is sliced LOCALLY from the replicated x at the chunk's
+        # source position, and the pre-FFN RMSNorm (row-wise, so it
+        # commutes with token chunking bitwise) applies here too.
+        # Every rank thereby consumes x and ln2 for ALL token chunks —
+        # the baseline's consumption pattern — so those replicated
+        # leaves' cotangents accumulate over all tokens per rank
+        # instead of one chunk's partial (probed live: slicing x once
+        # before the ring drifts the tied-embedding grad ~8% under
+        # unchecked-replication shard_map).
+        x1_c = jax.lax.dynamic_slice_in_dim(x, src * ct, ct, 1) + y_c
+        h = _rms_norm(x1_c, sub_params["ln2"]) if cfg.norm else x1_c
+        return jnp.einsum("btm,mf->btf", h, sub_params["wf1"],
+                          preferred_element_type=jnp.float32)
+
+    f_h = jax.nn.gelu(ring_allgather_matmul(ffn1_chunk, y_shard, tp,
+                                            gather_dim=1))
+    f_out = matmul_ring_reducescatter(
+        lambda c, _s: jnp.einsum("btf,fm->btm", c, sub_params["wf2"],
+                                 preferred_element_type=jnp.float32),
+        f_h, tp, chunk_dim=1)
+    delta = y_shard + f_out.astype(x.dtype)
+    return (x + unshard(delta))[:, :t_loc]
 
 
 def _stage_block(stage_params: Params, x, cfg: FlagshipConfig,

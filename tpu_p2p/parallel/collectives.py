@@ -27,7 +27,8 @@ timed region (SURVEY.md §7 hard part (b)).
 
 from __future__ import annotations
 
-from typing import Dict, Sequence, Tuple
+from collections import OrderedDict
+from typing import Callable, Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -271,6 +272,116 @@ def bucketed_all_gather(shards, axis: str, bucket_bytes=None):
     return out
 
 
+def ring_allgather_matmul(compute_chunk: Callable, x_shard, axis: str,
+                          gather_dim: int):
+    """All-gather ``x_shard`` chunks along mesh ``axis`` *through* a
+    matmul: each arriving ppermute chunk's ``compute_chunk`` issues
+    while the next chunk is still in flight.
+
+    The decomposition trick of Wang et al. (ASPLOS 2023) / Pope et
+    al. 2022: instead of ``all_gather`` → one big matmul (the gather
+    fully exposed on the ICI), unroll the gather into a shift-by-1
+    ``ppermute`` ring and consume each chunk the moment it lands. Each
+    loop step issues the NEXT hop's ppermute before this chunk's
+    matmul — nothing in the matmul depends on the in-flight buffer, so
+    XLA's latency-hiding scheduler lowers the transfer to
+    collective-permute-start/-done straddling the compute (the same
+    issue-before-consume ordering as ``ops/ring_flash.py`` KV blocks
+    and the FSDP prefetch gathers).
+
+    ``x_shard``: this rank's chunk of the gathered dimension.
+    ``compute_chunk(chunk, src) → y_chunk`` must be shape-uniform
+    across chunks and keep ``gather_dim``'s position (e.g. a
+    token-chunked einsum against a tp weight shard); ``src`` is the
+    (traced) ring index the chunk originated from, so the compute can
+    combine it with locally-sliced replicated operands (the flagship
+    ring join reconstructs each token chunk's residual this way).
+    Returns the rank-order concatenation of every rank's
+    ``compute_chunk`` output along ``gather_dim`` — exactly
+    ``compute(all_gather(x_shard))`` for any per-chunk-independent
+    ``compute``, replicated-in-value over ``axis`` like a tiled
+    all-gather.
+
+    Differentiable: the transpose of the ppermute ring is the mirrored
+    reverse ring, so the backward gets the same overlapped schedule
+    for free. A 1-sized axis degrades to
+    ``compute_chunk(x_shard, 0)``.
+    """
+    n = jax.lax.axis_size(axis)
+    if n == 1:
+        return compute_chunk(x_shard, 0)
+    idx = jax.lax.axis_index(axis)
+    fwd = [(j, (j + 1) % n) for j in range(n)]
+    cur, src, out = x_shard, idx, None
+    for s in range(n):
+        # Issue the next hop BEFORE consuming cur: the transfer has no
+        # consumer in this step's matmul, so it overlaps it.
+        nxt = jax.lax.ppermute(cur, axis, fwd) if s + 1 < n else None
+        y = compute_chunk(cur, src)
+        if out is None:
+            c = y.shape[gather_dim]
+            full = list(y.shape)
+            full[gather_dim] = n * c
+            out = jnp.zeros(tuple(full), y.dtype)
+            # Under vma-checked shard_map the fresh zeros buffer is
+            # unvarying while y varies over (at least) ``axis`` —
+            # promote it so the dynamic_update_slice operands agree
+            # (no-op on older jax and when y is already unvarying).
+            out, y = _promote_vma([out, y])
+        out = jax.lax.dynamic_update_slice_in_dim(out, y, src * c,
+                                                  gather_dim)
+        # ppermute j→j+1 means each hop delivers the chunk of one rank
+        # further upstream: idx-1, idx-2, ...
+        cur, src = nxt, (src - 1) % n
+    return out
+
+
+def matmul_ring_reducescatter(compute_chunk: Callable, x, axis: str,
+                              chunk_dim: int):
+    """Chunked matmul whose partial products are emitted and combined
+    per ring step — the overlapped decomposition of
+    ``psum(compute(x), axis)`` followed by slicing out this rank's
+    ``chunk_dim`` chunk (a matmul-fused reduce-scatter).
+
+    ``x`` is full along ``chunk_dim`` (every rank holds all chunks of
+    its *partial* operand — e.g. the head- or hidden-sharded side of a
+    Megatron join); ``compute_chunk(chunk, c) → partial`` computes
+    chunk ``c``'s partial product against this rank's weight shard
+    (``c`` is traced; most callers ignore it). Standard reduce-scatter
+    ring: the accumulator starts at the chunk that must travel
+    furthest and picks up one local partial per hop, so each step's
+    ppermute (of the accumulator) overlaps the next partial's matmul.
+    Rank ``i`` returns chunk ``i`` of the full sum.
+
+    ``x.shape[chunk_dim]`` must divide by the axis size — callers pad
+    (see ``flagship_forward._tp_ring_join``). Differentiable (the
+    transpose is the mirrored all-gather ring); a 1-sized axis
+    degrades to ``compute_chunk(x, 0)``.
+    """
+    n = jax.lax.axis_size(axis)
+    if n == 1:
+        return compute_chunk(x, 0)
+    if x.shape[chunk_dim] % n:
+        raise ValueError(
+            f"chunk dim {chunk_dim} of size {x.shape[chunk_dim]} does "
+            f"not divide by ring size {n} — pad before the ring"
+        )
+    idx = jax.lax.axis_index(axis)
+    ct = x.shape[chunk_dim] // n
+
+    def part(c):
+        chunk = jax.lax.dynamic_slice_in_dim(x, c * ct, ct, chunk_dim)
+        return compute_chunk(chunk, c)
+
+    rev = [(j, (j - 1) % n) for j in range(n)]
+    acc = part((idx + 1) % n)
+    for s in range(1, n):
+        # The accumulator's hop has no data dependency on this step's
+        # partial matmul — XLA overlaps the two.
+        acc = jax.lax.ppermute(acc, axis, rev) + part((idx + 1 + s) % n)
+    return acc
+
+
 class CollectiveCache:
     """Compile-once cache of jitted collective programs.
 
@@ -278,17 +389,51 @@ class CollectiveCache:
     and nothing per pair; XLA instead pays one compilation per
     (edge-set template, shape, dtype) — this cache plus explicit warm-up
     keeps that cost out of timed regions (SURVEY.md §7 hard part (b)).
+
+    Bounded: benchmark sweeps key the cache by (mesh, edge-set, chain
+    length, splits, ...), so an all-pairs sweep over a big mesh — or a
+    long bench session crossing many shapes — grows the dict without
+    limit, and each entry pins a compiled XLA executable. ``maxsize``
+    caps it LRU-style (least-recently-*used* entry evicted first;
+    ``None`` = unbounded). Eviction only drops the Python handle — a
+    re-request transparently rebuilds (and recompiles) the program, so
+    the cap trades recompile time for memory, never correctness.
+    ``len(cache)`` and :meth:`stats` expose occupancy for tests and
+    long-running drivers.
     """
 
-    def __init__(self) -> None:
-        self._cache: Dict[tuple, object] = {}
+    DEFAULT_MAXSIZE = 256
+
+    def __init__(self, maxsize: int = DEFAULT_MAXSIZE) -> None:
+        if maxsize is not None and maxsize < 1:
+            raise ValueError(f"maxsize must be >= 1 or None, got {maxsize}")
+        self._cache: "OrderedDict[tuple, object]" = OrderedDict()
+        self._maxsize = maxsize
+        self._hits = self._misses = self._evictions = 0
 
     def _get(self, key, builder):
         fn = self._cache.get(key)
-        if fn is None:
-            fn = builder()
-            self._cache[key] = fn
+        if fn is not None:
+            self._hits += 1
+            self._cache.move_to_end(key)  # most-recently-used
+            return fn
+        self._misses += 1
+        fn = builder()
+        self._cache[key] = fn
+        if self._maxsize is not None and len(self._cache) > self._maxsize:
+            self._cache.popitem(last=False)  # least-recently-used
+            self._evictions += 1
         return fn
+
+    def stats(self) -> Dict[str, object]:
+        """Occupancy + traffic counters (reset never; cheap ints)."""
+        return {
+            "size": len(self._cache),
+            "maxsize": self._maxsize,
+            "hits": self._hits,
+            "misses": self._misses,
+            "evictions": self._evictions,
+        }
 
     # -- point-to-point / permutation ------------------------------------
 
@@ -644,6 +789,56 @@ class CollectiveCache:
             )
 
         return self._get(edges_key, build)
+
+    def tp_ring_chain(self, mesh: Mesh, axis: str, count: int,
+                      k: int = 64):
+        """``count`` chained ring collective-matmul round trips — one
+        hop is :func:`ring_allgather_matmul` (gather the payload's
+        token chunks through a ``[k, k]`` matmul) followed by
+        :func:`matmul_ring_reducescatter` (emit + combine the partial
+        products back to this rank's chunk). Shape-preserving, so it
+        scans; the benchmark twin of the flagship
+        ``tp_overlap="ring"`` Megatron-join transport, measurable
+        against :meth:`rs_ag_chain` (the same bytes with the matmuls
+        outside the ring).
+
+        The payload's trailing dim is viewed as ``[elems // k, k]``
+        tokens × features (``elems % k == 0`` required); the weight is
+        a fixed identity so values stay bounded (each hop scales by
+        the axis size — wraps in integer dtypes, irrelevant to
+        transport timing, same note as :meth:`psum_chain`).
+        """
+        key = ("tp_ring_chain", mesh, axis, count, k)
+
+        def build():
+            spec = P(*mesh.axis_names, None)
+
+            def f(x):
+                if x.shape[-1] % k:
+                    raise ValueError(
+                        f"payload {x.shape[-1]} elems not divisible by "
+                        f"feature dim {k}")
+                shape = x.shape
+                w = jnp.eye(k, dtype=x.dtype)
+
+                def step(carry, _):
+                    y = carry.reshape(-1, k)
+                    full = ring_allgather_matmul(
+                        lambda c, _s: jnp.einsum("tk,kf->tf", c, w), y,
+                        axis, gather_dim=0)
+                    own = matmul_ring_reducescatter(
+                        lambda c, _s: jnp.einsum("tk,kf->tf", c, w),
+                        full, axis, chunk_dim=0)
+                    return own.astype(carry.dtype).reshape(shape), None
+
+                out, _ = jax.lax.scan(step, x, None, length=count)
+                return out
+
+            return jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+            )
+
+        return self._get(key, build)
 
     def __len__(self) -> int:
         return len(self._cache)

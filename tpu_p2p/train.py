@@ -389,6 +389,11 @@ def _build_parser() -> argparse.ArgumentParser:
                    choices=("none", "prefetch"),
                    help="with --zero-dp: FSDP gather schedule (prefetch "
                         "= double-buffered per-layer all-gather)")
+    p.add_argument("--tp-overlap", default="none",
+                   choices=("none", "ring"),
+                   help="Megatron tp-join schedule (ring = ppermute "
+                        "collective-matmul decomposition overlapping "
+                        "transfers with the matmuls; no-op at tp=1)")
     return p
 
 
@@ -418,6 +423,7 @@ def main(argv=None) -> int:
         sp_strategy=args.sp_strategy, use_flash=args.flash,
         norm=args.norm, dense_ffn=args.dense_ffn, rope=args.rope,
         remat=args.remat, zero_dp=args.zero_dp, overlap=args.overlap,
+        tp_overlap=args.tp_overlap,
     )
     summary = run_training(
         mesh, cfg, steps=args.steps, lr=args.lr, seed=args.seed,

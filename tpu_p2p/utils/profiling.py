@@ -42,6 +42,7 @@ __all__ = [
     "device_leaf_events",
     "differential_from_trace",
     "gather_overlap_fraction",
+    "tp_overlap_fraction",
     "validate_differential",
     "measure_headline",
 ]
@@ -443,6 +444,30 @@ def gather_overlap_fraction(trace_dir: str,
         "hidden_s": hidden_s,
         "compute_s": _union_len(cu),
     }
+
+
+def tp_overlap_fraction(trace_dir: str, window=None) -> Optional[dict]:
+    """Fraction of device collective-permute time hidden under
+    concurrent compute — the ``tp_overlap="ring"`` metric
+    (``bench.py``'s ``tp_overlap_frac``), the tp twin of
+    :func:`gather_overlap_fraction`.
+
+    The ring Megatron joins (``flagship_forward._tp_ring_join``) move
+    every byte over shift-by-1 ``ppermute`` hops, which XLA lowers to
+    ``collective-permute(-start/-done)`` device events; the same
+    interval algebra as the FSDP gather metric then measures how much
+    of that transfer time rides under matmuls. Same return contract:
+    ``None`` without a device track, ``frac=None`` when no
+    collective-permute exists in the capture (tp=1 or ring off —
+    nothing to hide). Note the flagship ring block also issues one
+    ``psum`` per join combine; that op is *deliberately* excluded —
+    the ring's claim is that the chunk transfers overlap, and the
+    psum combine is the non-overlapped remainder the fraction should
+    not flatter.
+    """
+    return gather_overlap_fraction(trace_dir,
+                                   names=("collective-permute",),
+                                   window=window)
 
 
 def differential_from_trace(trace_dir: str, n_short: int, n_long: int,

@@ -39,6 +39,11 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
         # gather schedule (prefetch = the double-buffered per-layer
         # all-gather of tpu_p2p/parallel/fsdp.py).
         mc = dataclasses.replace(mc, zero_dp=True, overlap=cfg.overlap)
+    if model_cfg is None and cfg.tp_overlap != "none":
+        # --tp-overlap ring: the ppermute collective-matmul Megatron
+        # joins (tpu_p2p/models/flagship_forward._tp_ring_join);
+        # degrades to the psum path on tp=1 meshes.
+        mc = dataclasses.replace(mc, tp_overlap=cfg.tp_overlap)
     # mc as the placement cfg: with zero_dp the param specs carry the
     # ZeRO dp dim, and placing without it would materialize full
     # replicas (the memory ZeRO exists to avoid) + a first-step
@@ -64,10 +69,14 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
     tok_s = tokens / s.p50 if s.p50 == s.p50 and s.p50 > 0 else float("nan")
     axes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if ctx.is_printer:
+        # tp_overlap rides the line only when active, so earlier
+        # rounds' flagship_step output stays byte-identical.
+        tp_part = (f" tp_overlap={mc.tp_overlap}"
+                   if mc.tp_overlap != "none" else "")
         sys.stdout.write(
             f"flagship_step mesh {axes} {mc.sp_strategy}-SP "
             f"B{mc.batch} T{mc.seq} H{mc.heads} E{mc.num_experts} "
-            f"S{mc.stages}x{mc.microbatches}mb {mc.dtype}: "
+            f"S{mc.stages}x{mc.microbatches}mb {mc.dtype}{tp_part}: "
             f"p50 {s.p50 * 1e3:.2f}ms/step  {tok_s:,.0f} tokens/s\n"
         )
         sys.stdout.flush()
@@ -77,6 +86,7 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
             msg_bytes=0, gbps_val=float("nan"), samples=s,
             mesh=str(axes), sp_strategy=mc.sp_strategy,
             batch=mc.batch, seq=mc.seq, tokens_per_s=tok_s,
+            tp_overlap=mc.tp_overlap,
         )
     )
     return {"mesh": axes, "p50_ms": s.p50 * 1e3, "tokens_per_s": tok_s}
