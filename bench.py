@@ -77,6 +77,9 @@ HEADLINE_KEYS = (
     "tp_overlap_frac",
     "tp_step_ms_overlap_none",
     "tp_step_ms_overlap_ring",
+    "ring_achieved_gbps",
+    "ag_achieved_gbps",
+    "obs_step_ms_p50",
     "flagship_step_ms",
     "decode_ms_per_token",
     "decode_hbm_ms_per_token",
@@ -663,6 +666,81 @@ def _tp_overlap_metrics(timing):
             f"tp_overlap loss divergence: none={losses['none']} "
             f"ring={losses['ring']}"
         )
+    return out
+
+
+# Null shape of _obs_metrics — failure must produce the same keys
+# (schema stability, mirroring FSDP_NULL / TP_NULL).
+OBS_NULL = {
+    "obs_devices": None,
+    "ring_achieved_gbps": None,
+    "ag_achieved_gbps": None,
+    "obs_step_ms_p50": None,
+    "obs_source": None,
+}
+
+
+def _obs_metrics(timing):
+    """Collective-ledger achieved bandwidth + step-timeline cadence
+    (round 8 tentpole — tpu_p2p/obs/, docs/observability.md).
+
+    ``ring_achieved_gbps`` / ``ag_achieved_gbps``: the ledger's
+    trace-join over one :func:`tpu_p2p.obs.ledger.live_capture` on a
+    flat mesh over every visible device — per-link busbw of a
+    shift-by-1 ppermute ring and per-participant busbw of a
+    slice-own-chunk all-gather chain, computed by matching recorded
+    issues (bytes from avals) against the device-trace collective
+    events. Null on platforms recording no device track (the
+    simulated CPU mesh) and on 1-device meshes (no link exists);
+    ``obs_source`` says which joined numbers published.
+
+    ``obs_step_ms_p50``: the step timeline's p50 wall step time from
+    an ``--obs-jsonl``-instrumented toy training run (host cadence
+    with a per-step sync — deliberately HOST-side: this metric guards
+    the loop's dispatch/data path, which the device-trace step slopes
+    cannot see).
+    """
+    import os
+    import tempfile
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_p2p.obs import ledger as L
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.asarray(devs).reshape(n), ("d",))
+    out = dict(OBS_NULL)
+    out["obs_devices"] = n
+    if n >= 2:
+        led, join = L.live_capture(mesh, msg_bytes=4 * 1024 * 1024,
+                                   count=8)
+        if not join.no_device_track:
+            pk = join.per_kind()
+            ring = pk.get("ppermute", {}).get("achieved_gbps")
+            ag = pk.get("all_gather", {}).get("achieved_gbps")
+            out["ring_achieved_gbps"] = (round(ring, 2)
+                                         if ring is not None else None)
+            out["ag_achieved_gbps"] = (round(ag, 2)
+                                       if ag is not None else None)
+            # Source stamps only published numbers: a device-tracked
+            # capture whose join produced NO value (event naming
+            # drift) must not claim device-trace-sourced output.
+            if ring is not None or ag is not None:
+                out["obs_source"] = "device_trace"
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.train import run_training
+
+    mesh1 = F.build_mesh(1, devices=jax.devices()[:1])
+    cfg = F.FlagshipConfig(batch=8, seq=64, heads=4, head_dim=16,
+                           stages=2, microbatches=2, num_experts=2,
+                           capacity_factor=4.0, norm=True)
+    with tempfile.TemporaryDirectory(prefix="bench_obs_") as td:
+        s = run_training(mesh1, cfg, steps=6, lr=1e-2, log_every=0,
+                         obs_jsonl=os.path.join(td, "obs.jsonl"))
+    out["obs_step_ms_p50"] = s.get("obs_step_ms_p50")
     return out
 
 
@@ -1461,6 +1539,14 @@ def main() -> int:
         print(f"# tp overlap measurement failed: {e!r}", file=sys.stderr)
         tp_m = {}
     result["detail"].update({k: tp_m.get(k) for k in TP_NULL})
+    # Observability metrics (round-8 tentpole): ledger-joined achieved
+    # collective bandwidth + timeline step cadence, both branches.
+    try:
+        obs_m = _obs_metrics(timing)
+    except Exception as e:  # noqa: BLE001 — same rationale
+        print(f"# obs measurement failed: {e!r}", file=sys.stderr)
+        obs_m = {}
+    result["detail"].update({k: obs_m.get(k) for k in OBS_NULL})
 
     detail_path = _detail_path()
     try:

@@ -45,6 +45,15 @@ SUMMARY_PATTERNS = {
     "flagship_tp_ring": ["--cpu-mesh", "8", "--pattern",
                          "flagship_step", "--tp-overlap", "ring",
                          "--iters", "2"],
+    # The round-8 obs subcommand end to end: live collective-ledger
+    # capture (deterministic issue/byte totals on the 8-dev CPU mesh,
+    # where no device track exists and the report says so) plus the
+    # regress gate against the repo trajectory. --current is pinned to
+    # BENCH_r05.json so future driver rounds appending BENCH_r06+ do
+    # not shift this golden; the gate must exit 0 (the acceptance
+    # criterion) or _run_cli fails the returncode assert.
+    "obs": ["obs", "--cpu-mesh", "8", "--msg-size", "256KiB",
+            "--count", "4", "--current", "BENCH_r05.json"],
 }
 
 _FIELD = re.compile(r" *\d+\.\d\d")  # a whole padded %6.02f field

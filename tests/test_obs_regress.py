@@ -1,0 +1,264 @@
+"""Regression gate (tpu_p2p.obs.regress): artifact-format loading
+(all three driver eras), tolerance semantics, the verdict table, and
+the end-to-end ``python -m tpu_p2p obs`` exit-code contract against
+the repo's own BENCH_r*.json trajectory."""
+
+import io
+import json
+import os
+
+import pytest
+
+from tpu_p2p.obs import regress as R
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _write(tmp_path, name, obj):
+    p = os.path.join(str(tmp_path), name)
+    with open(p, "w") as fh:
+        json.dump(obj, fh)
+    return p
+
+
+# ------------------------------------------------------------ loading
+
+
+def test_headline_from_old_parsed_detail():
+    head = R.headline_from_artifact({
+        "parsed": {"metric": "m", "value": 1.0,
+                   "detail": {"hbm_gbytes_per_s": 703.4,
+                              "flash_attention_tflops": 97.3,
+                              "unrelated": 5}},
+    })
+    assert head == {"hbm_gbytes_per_s": 703.4,
+                    "flash_attention_tflops": 97.3}
+
+
+def test_headline_from_compact_line_era():
+    head = R.headline_from_artifact({
+        "parsed": {"metric": "m", "value": 1.0,
+                   "headline": {"flagship_step_ms": 5.29,
+                                "ring_achieved_gbps": 123.4}},
+    })
+    assert head == {"flagship_step_ms": 5.29,
+                    "ring_achieved_gbps": 123.4}
+
+
+def test_headline_from_parsed_null_recovers_from_tail():
+    # The round-5 failure mode: parsed null, numbers only in the
+    # truncated stdout tail. Regex recovery, last occurrence wins.
+    tail = ('junk "hbm_gbytes_per_s": 100.0 more '
+            '{"hbm_gbytes_per_s": 656.9, "flagship_step_ms": 5.29,')
+    head = R.headline_from_artifact({"parsed": None, "tail": tail})
+    assert head == {"hbm_gbytes_per_s": 656.9,
+                    "flagship_step_ms": 5.29}
+
+
+def test_headline_ignores_non_numeric_and_booleans():
+    head = R.headline_from_artifact({
+        "parsed": {"detail": {"hbm_gbytes_per_s": None,
+                              "flagship_step_ms": True,
+                              "flash_attention_tflops": 97.3}},
+    })
+    assert head == {"flash_attention_tflops": 97.3}
+
+
+def test_load_trajectory_orders_and_excludes_future(tmp_path):
+    _write(tmp_path, "BENCH_r01.json",
+           {"parsed": {"detail": {"hbm_gbytes_per_s": 700.0}}})
+    _write(tmp_path, "BENCH_r02.json",
+           {"parsed": {"detail": {"hbm_gbytes_per_s": 650.0}}})
+    _write(tmp_path, "BENCH_r03.json",
+           {"parsed": {"detail": {"hbm_gbytes_per_s": 660.0}}})
+    # Gate r02: r01 is prior, r03 (the future) must not be.
+    name, cur, priors = R.load_trajectory(str(tmp_path),
+                                          "BENCH_r02.json")
+    assert name == "BENCH_r02.json"
+    assert cur == {"hbm_gbytes_per_s": 650.0}
+    assert [n for n, _ in priors] == ["BENCH_r01.json"]
+    # Default current = newest.
+    name, _, priors = R.load_trajectory(str(tmp_path))
+    assert name == "BENCH_r03.json"
+    assert [n for n, _ in priors] == ["BENCH_r01.json",
+                                      "BENCH_r02.json"]
+
+
+def test_load_trajectory_explicit_path_still_excludes_future(tmp_path):
+    # Review fix: an explicit --current PATH spelling the same round
+    # differently than the glob ('/abs/BENCH_r02.json' vs
+    # './BENCH_r02.json') must still exclude future rounds — the
+    # exclusion compares basenames, not raw path strings.
+    _write(tmp_path, "BENCH_r01.json",
+           {"parsed": {"detail": {"hbm_gbytes_per_s": 700.0}}})
+    p2 = _write(tmp_path, "BENCH_r02.json",
+                {"parsed": {"detail": {"hbm_gbytes_per_s": 650.0}}})
+    _write(tmp_path, "BENCH_r03.json",
+           {"parsed": {"detail": {"hbm_gbytes_per_s": 900.0}}})
+    name, cur, priors = R.load_trajectory(str(tmp_path),
+                                          os.path.abspath(p2))
+    assert name == "BENCH_r02.json"
+    assert cur == {"hbm_gbytes_per_s": 650.0}
+    assert [n for n, _ in priors] == ["BENCH_r01.json"]
+
+
+def test_load_trajectory_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        R.load_trajectory(str(tmp_path))
+
+
+def test_load_trajectory_baseline_published_joins(tmp_path):
+    _write(tmp_path, "BASELINE.json",
+           {"published": {"hbm_gbytes_per_s": 800.0}})
+    _write(tmp_path, "BENCH_r01.json",
+           {"parsed": {"detail": {"hbm_gbytes_per_s": 700.0}}})
+    _write(tmp_path, "BENCH_r02.json",
+           {"parsed": {"detail": {"hbm_gbytes_per_s": 690.0}}})
+    _, _, priors = R.load_trajectory(str(tmp_path))
+    assert [n for n, _ in priors] == ["BASELINE.json",
+                                      "BENCH_r01.json"]
+
+
+# --------------------------------------------------------- comparison
+
+
+def _rows_by_key(rows):
+    return {r["key"]: r for r in rows}
+
+
+def test_compare_higher_better_regression():
+    rows = _rows_by_key(R.compare(
+        {"hbm_gbytes_per_s": 500.0},
+        [("r1", {"hbm_gbytes_per_s": 700.0})],
+    ))
+    r = rows["hbm_gbytes_per_s"]
+    # 500 < 700 * (1 - 0.15): regressed.
+    assert r["verdict"] == "REGRESSED"
+    assert r["ref"] == 700.0
+    # Within tolerance: OK.
+    rows = _rows_by_key(R.compare(
+        {"hbm_gbytes_per_s": 650.0},
+        [("r1", {"hbm_gbytes_per_s": 700.0})],
+    ))
+    assert rows["hbm_gbytes_per_s"]["verdict"] == "OK"
+
+
+def test_compare_lower_better_and_best_prior_reference():
+    # Reference is the BEST prior (min for lower-better), not the
+    # last: a noisy slow round must not ratchet the bar down.
+    rows = _rows_by_key(R.compare(
+        {"flagship_step_ms": 8.0},
+        [("r1", {"flagship_step_ms": 5.0}),
+         ("r2", {"flagship_step_ms": 9.0})],
+    ))
+    r = rows["flagship_step_ms"]
+    assert r["ref"] == 5.0
+    assert r["verdict"] == "REGRESSED"  # 8 > 5 * 1.2
+    rows = _rows_by_key(R.compare(
+        {"flagship_step_ms": 5.5},
+        [("r1", {"flagship_step_ms": 5.0})],
+    ))
+    assert rows["flagship_step_ms"]["verdict"] == "OK"
+
+
+def test_compare_missing_keys_skip_never_fail():
+    rows = _rows_by_key(R.compare({}, [("r1", {})]))
+    assert all(r["verdict"] == "SKIP" for r in rows.values())
+    # New key with no prior: SKIP (headline keys accrete by design).
+    rows = _rows_by_key(R.compare({"ring_achieved_gbps": 100.0}, []))
+    assert rows["ring_achieved_gbps"]["verdict"] == "SKIP"
+
+
+def test_print_gate_rc_and_table():
+    rows = R.compare(
+        {"hbm_gbytes_per_s": 500.0, "flagship_step_ms": 5.0},
+        [("r1", {"hbm_gbytes_per_s": 700.0, "flagship_step_ms": 5.0})],
+    )
+    s = io.StringIO()
+    rc = R.print_gate("BENCH_rXX.json", rows, [("r1", {})], stream=s)
+    out = s.getvalue()
+    assert rc == 1
+    assert "REGRESSED" in out and "verdict" in out
+    assert "# verdict: REGRESSED (1 regressions" in out
+    # All-OK trajectory exits 0.
+    rows = R.compare(
+        {"hbm_gbytes_per_s": 700.0},
+        [("r1", {"hbm_gbytes_per_s": 700.0})],
+    )
+    s = io.StringIO()
+    assert R.print_gate("x", rows, [], stream=s) == 0
+    assert "# verdict: OK" in s.getvalue()
+
+
+def test_every_tolerance_key_is_a_bench_headline_key():
+    # The gate can only see keys that ride the compact line — a
+    # tolerance on a key bench.py never publishes is dead config.
+    import importlib.util
+
+    path = os.path.join(REPO, "bench.py")
+    spec = importlib.util.spec_from_file_location("bench_for_obs", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for key in R.TOLERANCES:
+        assert key in mod.HEADLINE_KEYS, key
+
+
+# --------------------------------------------------------- end to end
+
+
+def test_gate_passes_against_repo_trajectory():
+    # The acceptance pin: gating the repo's own current BENCH_r05.json
+    # against its r01-r04 trajectory returns 0 (no regression) — the
+    # exact check CI runs via `python -m tpu_p2p obs`.
+    name, cur, priors = R.load_trajectory(REPO, "BENCH_r05.json")
+    assert name == "BENCH_r05.json"
+    assert cur  # tail-recovered despite parsed: null
+    assert len(priors) == 4
+    rows = R.compare(cur, priors)
+    s = io.StringIO()
+    assert R.print_gate(name, rows, priors, stream=s) == 0
+    byk = _rows_by_key(rows)
+    # The keys the trajectory carries actually compared (not SKIP).
+    for key in ("hbm_gbytes_per_s", "flash_attention_tflops",
+                "flagship_step_ms", "decode_ms_per_token"):
+        assert byk[key]["verdict"] == "OK", key
+
+
+def test_obs_cli_no_live_gate_only(capsys):
+    # The subcommand path through tpu_p2p.cli without touching the
+    # mesh: gate-only, rc 0, verdict table printed.
+    from tpu_p2p.cli import main
+
+    rc = main(["obs", "--no-live", "--artifacts-dir", REPO,
+               "--current", "BENCH_r05.json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "# obs regress: current=BENCH_r05.json" in out
+    assert "# verdict: OK" in out
+
+
+def test_obs_cli_detects_regression(tmp_path, capsys):
+    _write(tmp_path, "BENCH_r01.json",
+           {"parsed": {"detail": {"hbm_gbytes_per_s": 700.0}}})
+    _write(tmp_path, "BENCH_r02.json",
+           {"parsed": {"detail": {"hbm_gbytes_per_s": 400.0}}})
+    from tpu_p2p.cli import main
+
+    rc = main(["obs", "--no-live", "--artifacts-dir", str(tmp_path)])
+    assert rc == 1
+    assert "REGRESSED" in capsys.readouterr().out
+
+
+def test_obs_cli_current_detail_json(tmp_path, capsys):
+    # --current may point at a BENCH_detail.json (the file bench.py
+    # writes): keys under "detail".
+    _write(tmp_path, "BENCH_r01.json",
+           {"parsed": {"detail": {"hbm_gbytes_per_s": 700.0}}})
+    cur = _write(tmp_path, "detail.json",
+                 {"metric": "m", "detail": {"hbm_gbytes_per_s": 690.0}})
+    from tpu_p2p.cli import main
+
+    rc = main(["obs", "--no-live", "--artifacts-dir", str(tmp_path),
+               "--current", cur])
+    assert rc == 0
+    assert "current=detail.json" in capsys.readouterr().out

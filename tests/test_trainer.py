@@ -267,3 +267,37 @@ def test_cosine_resume_horizon_change_rejected(tmp_path):
     # …while unchanged flags resume cleanly (no-op: already at 4).
     out = run_training(mesh, cfg, steps=4, ckpt_dir=ck, resume=True, **kw)
     assert out["steps_run"] == 0 and out["start_step"] == 4
+
+
+def test_log_jsonl_record_schema_roundtrip(tmp_path):
+    # Satellite contract (round 8): the training log's record shapes
+    # are a pinned schema, not an implicit format — the obs records
+    # (tpu_p2p/obs/timeline.py, --obs-jsonl) extend a TESTED contract
+    # and live in their OWN file, so these shapes are exhaustive here.
+    mesh = F.build_mesh(8)
+    cfg = _cfg()
+    log = tmp_path / "log.jsonl"
+    run_training(mesh, cfg, steps=4, lr=5e-2, log_every=2,
+                 eval_every=2, eval_batches=1, log_path=str(log))
+    lines = log.read_text().splitlines()
+    recs = [json.loads(ln) for ln in lines]
+    step_recs = [r for r in recs if "loss" in r]
+    eval_recs = [r for r in recs if "eval_loss" in r]
+    assert step_recs and eval_recs
+    for r in step_recs:
+        # The step/loss key contract, exactly.
+        assert set(r) == {"step", "loss", "wall_s", "tokens_per_s_wall"}
+        assert isinstance(r["step"], int)
+        assert isinstance(r["loss"], float)
+        assert isinstance(r["wall_s"], float)
+        assert isinstance(r["tokens_per_s_wall"], int)
+    for r in eval_recs:
+        assert set(r) == {"step", "eval_loss"}
+        assert isinstance(r["step"], int)
+        assert isinstance(r["eval_loss"], float)
+    # Round trip: each line re-serializes to itself (the file IS the
+    # machine contract — no NaN/Inf literals, no key reordering drift).
+    for ln, r in zip(lines, recs):
+        assert json.dumps(r) == ln
+    # No obs-shaped records leak into the training log.
+    assert not any("obs" in r for r in recs)

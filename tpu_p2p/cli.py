@@ -261,6 +261,16 @@ def _assert_resume_agreement(done: dict) -> None:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "obs":
+        # ``python -m tpu_p2p obs`` — the observability report +
+        # bench regression gate (tpu_p2p/obs/regress.py). Dispatched
+        # before the benchmark argparse: the subcommand has its own
+        # flag set and exit-code contract (nonzero on regression).
+        from tpu_p2p.obs.regress import main as obs_main
+
+        return obs_main(list(argv[1:]))
     args = build_parser().parse_args(argv)
     try:
         if args.cpu_mesh:

@@ -1,0 +1,28 @@
+"""L9 — observability: collective ledger, step timeline, regress gate.
+
+The reference's entire output is one N×N bandwidth matrix printed at
+exit; after the overlap work (``overlap="prefetch"``,
+``tp_overlap="ring"``) this framework *hides* its collectives under
+compute and could only report two scalar overlap fractions. This
+package rebuilds the paper's matrix as a live observability layer over
+real steps, MegaScale-style (Jiang et al., 2024 — PAPERS.md):
+
+- :mod:`tpu_p2p.obs.ledger` — issue-time registry of every collective
+  ``tpu_p2p.parallel.collectives`` / ``tpu_p2p.parallel.fsdp`` emits
+  (kind, mesh axis, participants, payload bytes from avals), plus the
+  trace-join pass that matches ledger entries against device events
+  (:mod:`tpu_p2p.utils.profiling`) into per-collective achieved Gbps,
+  per-axis summaries, and a per-link N×N achieved-bandwidth matrix.
+- :mod:`tpu_p2p.obs.timeline` — span-based host-side step telemetry
+  (data/step/eval/checkpoint spans → JSONL through ``train.py``'s
+  emit path behind ``--obs-jsonl``), correlated to a sampled
+  device-trace window (device-busy + overlap fractions per step row).
+- :mod:`tpu_p2p.obs.regress` — the CI gate: compares a current
+  headline against the ``BENCH_r*.json`` trajectory with per-key
+  tolerances and exits nonzero on regression
+  (``python -m tpu_p2p obs``).
+
+Deliberately import-light: :mod:`tpu_p2p.parallel.collectives` imports
+the ledger at module load, so nothing here may import the parallel /
+models layers at module scope (render/capture helpers defer those).
+"""

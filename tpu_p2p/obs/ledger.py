@@ -1,0 +1,454 @@
+"""Collective ledger: issue-time registry + device-trace join.
+
+The reference prints achieved bandwidth because it *is* the workload
+(``p2p_matrix.cc`` times its own sends); a training step's collectives
+are issued by library code and measured by nobody. The ledger closes
+that gap in two halves:
+
+**Recording** (issue time). ``tpu_p2p.parallel.collectives`` and
+``tpu_p2p.parallel.fsdp`` call :func:`record_issue` inside their
+traced functions. Tracing runs the Python body once per compilation,
+so recording costs one list-append per collective *per compile* —
+zero per-execution overhead — and every payload size is computed from
+the operand's aval (shape × itemsize), never by materializing data.
+When no ledger is active (the default), :func:`record_issue` is a
+single truthiness check. Corollary: a program compiled *before* the
+ledger was enabled records nothing — enable recording around the
+first call of a fresh program (a fresh ``CollectiveCache`` /
+``jax.jit``), not around a warm one.
+
+**Joining** (trace time). :func:`join_trace` matches ledger entries
+against the device-track collective events of a
+``jax.profiler.trace`` capture
+(:func:`tpu_p2p.utils.profiling.device_collective_intervals` — async
+``*-start``/``*-done`` pairs bridged into one interval, lowest device
+pid only). Match rule, per kind: ledger entries are expanded by their
+``count`` (a chain of k hops = k issues, in issue order) and device
+events are matched cyclically in time order — event ``i`` joins entry
+``i mod len(entries)``. The cyclic match makes the join robust to the
+two structural mismatches a real capture has: the trace may hold
+several executions of the program (warm-up + timed runs), and a
+collective recorded once at trace time inside a ``lax.scan`` body
+executes ``length`` times on the device. A kind whose event count is
+not a whole multiple of its entry count is flagged ``ragged`` (a
+foreign program's collectives in the window) but still joined — the
+per-event byte attribution is unchanged.
+
+Achieved bandwidth is busbw-style: each joined event publishes
+``wire_bytes * 8 / duration`` where :func:`wire_bytes` applies the
+NCCL bus-bandwidth conventions this repo already uses
+(``collectives.all_gather`` docstring): per directed link for
+``ppermute``; ``(n-1)×shard`` for all-gather; ``(n-1)/n × buffer``
+for reduce-scatter and all-to-all; ``2(n-1)/n × buffer`` for
+all-reduce. Aggregates: per-kind and per-axis summaries, and — for
+edge-carrying (ppermute) entries, whose participants are known
+per-link — the N×N achieved-bandwidth matrix, rendered with the same
+matrix formatting as the workloads (``utils/report.py``).
+"""
+
+from __future__ import annotations
+
+import math
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "CollectiveIssue",
+    "CollectiveLedger",
+    "TraceJoin",
+    "KINDS",
+    "active",
+    "recording",
+    "record_issue",
+    "wire_bytes",
+    "aval_bytes",
+    "kind_of_event",
+    "join_trace",
+    "live_capture",
+    "print_report",
+]
+
+# Ledger kinds → the substring their XLA device-op names carry.
+# Checked in order; "reduce-scatter" and "collective-permute" must
+# precede the shorter matches they contain pieces of.
+KINDS = (
+    ("ppermute", "collective-permute"),
+    ("all_gather", "all-gather"),
+    ("reduce_scatter", "reduce-scatter"),
+    ("all_to_all", "all-to-all"),
+    ("all_reduce", "all-reduce"),
+)
+_KIND_NAMES = tuple(k for k, _ in KINDS)
+
+
+def kind_of_event(name: str) -> Optional[str]:
+    """Map one device collective-event name to a ledger kind (None for
+    collective events outside the ledger's vocabulary)."""
+    low = name.lower()
+    for kind, sub in KINDS:
+        if sub in low:
+            return kind
+    return None
+
+
+def wire_bytes(kind: str, axis_size: int, payload_bytes: int) -> int:
+    """Bytes crossing links per participant, busbw convention.
+
+    ``payload_bytes`` is the LOCAL aval bytes of the collective's
+    input operand (a shard for all-gather, the full local buffer for
+    the reductions, the per-link buffer for ppermute) — see the
+    module docstring for the per-kind algebra.
+    """
+    n = int(axis_size)
+    if kind == "ppermute":
+        return int(payload_bytes)  # per directed link
+    if kind == "all_gather":
+        return (n - 1) * int(payload_bytes)
+    if kind == "reduce_scatter":
+        return (n - 1) * int(payload_bytes) // max(n, 1)
+    if kind == "all_to_all":
+        return (n - 1) * int(payload_bytes) // max(n, 1)
+    if kind == "all_reduce":
+        return 2 * (n - 1) * int(payload_bytes) // max(n, 1)
+    raise ValueError(f"unknown collective kind {kind!r}")
+
+
+def aval_bytes(x) -> int:
+    """Payload bytes of an array/tracer from its aval alone."""
+    return int(np.prod(x.shape, dtype=np.int64)) * np.dtype(x.dtype).itemsize
+
+
+@dataclass(frozen=True)
+class CollectiveIssue:
+    """One recorded collective (possibly a chained repetition)."""
+
+    kind: str
+    axis: str
+    participants: Tuple[int, ...]  # axis-local rank ids
+    payload_bytes: int  # local input-operand aval bytes
+    wire_bytes: int  # bytes crossing links per participant (busbw)
+    count: int = 1  # chained repetitions (e.g. a scan length)
+    edges: Optional[Tuple[Tuple[int, int], ...]] = None  # ppermute only
+    label: str = ""
+
+
+class CollectiveLedger:
+    """Append-only registry of :class:`CollectiveIssue` entries."""
+
+    def __init__(self) -> None:
+        self.issues: List[CollectiveIssue] = []
+
+    def clear(self) -> None:
+        self.issues.clear()
+
+    def __len__(self) -> int:
+        return len(self.issues)
+
+    def expanded(self) -> List[CollectiveIssue]:
+        """Issues flattened by ``count``, in issue order — the unit the
+        trace join matches device events against."""
+        out: List[CollectiveIssue] = []
+        for it in self.issues:
+            out.extend([it] * it.count)
+        return out
+
+    def totals(self) -> Dict[Tuple[str, str], Dict[str, int]]:
+        """→ ``{(kind, axis): {"issues", "payload_bytes",
+        "wire_bytes"}}`` — byte totals count every chained repetition.
+        """
+        out: Dict[Tuple[str, str], Dict[str, int]] = {}
+        for it in self.issues:
+            d = out.setdefault((it.kind, it.axis), {
+                "issues": 0, "payload_bytes": 0, "wire_bytes": 0,
+            })
+            d["issues"] += it.count
+            d["payload_bytes"] += it.payload_bytes * it.count
+            d["wire_bytes"] += it.wire_bytes * it.count
+        return out
+
+
+# Stack, not a single slot: nested `recording()` scopes each see the
+# issues recorded inside them (an outer run-level ledger and an inner
+# per-step one both get the entry).
+_STACK: List[CollectiveLedger] = []
+
+
+def active() -> Optional[CollectiveLedger]:
+    """The innermost recording ledger, or None when recording is off."""
+    return _STACK[-1] if _STACK else None
+
+
+@contextmanager
+def recording(ledger: Optional[CollectiveLedger] = None):
+    """Enable issue recording for the dynamic extent of the block."""
+    led = ledger if ledger is not None else CollectiveLedger()
+    _STACK.append(led)
+    try:
+        yield led
+    finally:
+        _STACK.remove(led)
+
+
+def record_issue(kind: str, axis, *, nbytes: int, axis_size: int,
+                 edges: Optional[Sequence[Tuple[int, int]]] = None,
+                 count: int = 1, label: str = "") -> None:
+    """Record one issued collective into every active ledger.
+
+    Called from traced library code (``collectives.py`` / ``fsdp.py``)
+    — a no-op costing one truthiness check when nothing records.
+    ``nbytes`` must come from the operand's aval
+    (:func:`aval_bytes`), never from data.
+    """
+    if not _STACK:
+        return
+    entry = CollectiveIssue(
+        kind=kind, axis=str(axis),
+        participants=tuple(range(int(axis_size))),
+        payload_bytes=int(nbytes),
+        wire_bytes=wire_bytes(kind, axis_size, nbytes),
+        count=int(count),
+        edges=(tuple((int(s), int(d)) for s, d in edges)
+               if edges is not None else None),
+        label=label,
+    )
+    for led in _STACK:
+        led.issues.append(entry)
+
+
+# ------------------------------------------------------------- join
+
+
+@dataclass(frozen=True)
+class JoinedEvent:
+    """One device collective event matched to its ledger entry."""
+
+    issue: CollectiveIssue
+    t0: float  # seconds since trace epoch
+    t1: float
+    event_name: str
+
+    @property
+    def seconds(self) -> float:
+        return self.t1 - self.t0
+
+    @property
+    def achieved_gbps(self) -> float:
+        s = self.seconds
+        return (self.issue.wire_bytes * 8 / s / 1e9) if s > 0 else math.nan
+
+
+@dataclass
+class TraceJoin:
+    """Result of matching a ledger against one device-trace capture."""
+
+    joined: List[JoinedEvent] = field(default_factory=list)
+    # kinds present on the device track with no ledger entry to join
+    # (a foreign program's collectives, or an uninstrumented call
+    # site): {kind: {"events": n, "seconds": total}} — surfaced, not
+    # silently dropped, so the ledger's coverage is auditable.
+    unmatched: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    # kinds whose event count was not a whole multiple of the entry
+    # count (see module docstring) — joined anyway, flagged here.
+    ragged: Tuple[str, ...] = ()
+    no_device_track: bool = False
+
+    def per_kind(self) -> Dict[str, Dict[str, float]]:
+        """→ ``{kind: {"events", "wire_bytes", "seconds",
+        "achieved_gbps"}}`` over the joined events."""
+        return self._aggregate(lambda j: j.issue.kind)
+
+    def per_axis(self) -> Dict[str, Dict[str, float]]:
+        """Same aggregation keyed by mesh axis."""
+        return self._aggregate(lambda j: j.issue.axis)
+
+    def per_kind_axis(self) -> Dict[Tuple[str, str], Dict[str, float]]:
+        """Same aggregation keyed by ``(kind, axis)`` — the report
+        table's key, so a kind used on two mesh axes (dp FSDP gathers
+        next to tp gathers) cannot double-count across rows."""
+        return self._aggregate(lambda j: (j.issue.kind, j.issue.axis))
+
+    def _aggregate(self, key_fn) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+        for j in self.joined:
+            d = out.setdefault(key_fn(j), {
+                "events": 0, "wire_bytes": 0, "seconds": 0.0,
+            })
+            d["events"] += 1
+            d["wire_bytes"] += j.issue.wire_bytes
+            d["seconds"] += j.seconds
+        for d in out.values():
+            d["achieved_gbps"] = (
+                d["wire_bytes"] * 8 / d["seconds"] / 1e9
+                if d["seconds"] > 0 else None
+            )
+        return out
+
+    def link_matrix(self, n: Optional[int] = None) -> List[List[float]]:
+        """Per-link achieved Gbps from the edge-carrying (ppermute)
+        joined events: cell ``[src][dst]`` = total bytes over total
+        device seconds on that directed link; NaN where no ledger
+        traffic crossed it. Axis collectives (all-gather &c) have no
+        per-link attribution without assuming the ring algorithm — they
+        stay in :meth:`per_kind`/:meth:`per_axis`."""
+        edged = [j for j in self.joined if j.issue.edges]
+        if n is None:
+            n = 1 + max(
+                (max(max(e) for e in j.issue.edges) for j in edged),
+                default=-1,
+            )
+        secs: Dict[Tuple[int, int], float] = {}
+        bts: Dict[Tuple[int, int], int] = {}
+        for j in edged:
+            for src, dst in j.issue.edges:
+                # One ppermute event covers all its edges concurrently
+                # (XLA CollectivePermute is full-duplex), so each edge
+                # sees the full payload over the full event span.
+                bts[(src, dst)] = bts.get((src, dst), 0) + j.issue.payload_bytes
+                secs[(src, dst)] = secs.get((src, dst), 0.0) + j.seconds
+        m = [[math.nan] * n for _ in range(n)]
+        for (src, dst), b in bts.items():
+            s = secs[(src, dst)]
+            if src < n and dst < n:
+                m[src][dst] = (b * 8 / s / 1e9) if s > 0 else math.nan
+        return m
+
+
+def join_trace(ledger: CollectiveLedger, trace_dir: str,
+               window=None) -> TraceJoin:
+    """Match ``ledger`` entries against the device collective events
+    of one ``jax.profiler.trace`` capture (see module docstring for
+    the match semantics). ``no_device_track=True`` (and an empty join)
+    on platforms recording host events only — the simulated CPU mesh.
+    """
+    from tpu_p2p.utils.profiling import device_collective_intervals
+
+    intervals = device_collective_intervals(trace_dir, window=window)
+    if intervals is None:
+        return TraceJoin(no_device_track=True)
+    by_kind_events: Dict[str, List[Tuple[str, float, float]]] = {}
+    unmatched: Dict[str, Dict[str, float]] = {}
+    for name, t0, t1 in intervals:
+        kind = kind_of_event(name)
+        if kind is None:
+            d = unmatched.setdefault("other", {"events": 0, "seconds": 0.0})
+            d["events"] += 1
+            d["seconds"] += t1 - t0
+            continue
+        by_kind_events.setdefault(kind, []).append((name, t0, t1))
+    by_kind_issues: Dict[str, List[CollectiveIssue]] = {}
+    for it in ledger.expanded():
+        by_kind_issues.setdefault(it.kind, []).append(it)
+    joined: List[JoinedEvent] = []
+    ragged: List[str] = []
+    for kind, evs in by_kind_events.items():
+        issues = by_kind_issues.get(kind)
+        if not issues:
+            d = unmatched.setdefault(kind, {"events": 0, "seconds": 0.0})
+            d["events"] += len(evs)
+            d["seconds"] += sum(t1 - t0 for _, t0, t1 in evs)
+            continue
+        if len(evs) % len(issues):
+            ragged.append(kind)
+        for i, (name, t0, t1) in enumerate(evs):
+            joined.append(JoinedEvent(
+                issue=issues[i % len(issues)], t0=t0, t1=t1,
+                event_name=name,
+            ))
+    joined.sort(key=lambda j: j.t0)
+    return TraceJoin(joined=joined, unmatched=unmatched,
+                     ragged=tuple(sorted(ragged)))
+
+
+# ------------------------------------------------- live capture/report
+
+
+def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
+                 count: int = 8):
+    """Run instrumented ring-ppermute and all-gather chains on
+    ``mesh`` under a fresh ledger + ``jax.profiler.trace``; join.
+
+    The obs twin of the reference's exit-time matrix: a shift-by-1
+    ring (every directed nearest-neighbor link, one compiled program)
+    and a slice-own-chunk all-gather chain, both ``count`` hops, give
+    the per-link matrix and the per-axis gather bandwidth from ONE
+    capture. → ``(ledger, TraceJoin)``; on a 1-device mesh (no link
+    exists) the ledger is empty and the join is empty too — but NOT
+    marked ``no_device_track``: that flag means the platform records
+    host events only, which would be a false diagnosis on a 1-chip
+    TPU. Callers distinguish the cases by device count.
+    """
+    import tempfile
+
+    import jax
+
+    from tpu_p2p.parallel import collectives as C
+
+    axis = mesh.axis_names[0]
+    n = mesh.shape[axis]
+    led = CollectiveLedger()
+    if n < 2:
+        return led, TraceJoin()
+    cache = C.CollectiveCache()
+    payload = C.make_payload(mesh, msg_bytes)
+    with recording(led):
+        ring = cache.permute_chain(mesh, axis, C.ring_edges(n), count)
+        ag = cache.ag_chain(mesh, axis, count)
+        # First calls trace (and therefore record); untraced warm-up —
+        # compile time must not land inside the capture.
+        jax.block_until_ready(ring(payload))
+        jax.block_until_ready(ag(payload))
+    with tempfile.TemporaryDirectory(prefix="obs_cap_") as td:
+        with jax.profiler.trace(td):
+            jax.block_until_ready(ring(payload))
+            jax.block_until_ready(ag(payload))
+        join = join_trace(led, td)
+    return led, join
+
+
+def print_report(ledger: CollectiveLedger, join: TraceJoin, n: int,
+                 stream=None, title: str = "Ledger-Joined") -> None:
+    """Human-readable obs report: ledger totals table, per-kind
+    achieved bandwidth, and — when the platform recorded a device
+    track — the per-link N×N matrix in the workloads' format."""
+    import sys
+
+    from tpu_p2p.utils.report import render_matrix
+
+    out = stream if stream is not None else sys.stdout
+    per_ka = join.per_kind_axis()
+    out.write("# collective ledger\n")
+    out.write("# kind            axis  issues   payload_B      wire_B"
+              "  events  achieved_gbps\n")
+    for (kind, axis), tot in sorted(ledger.totals().items()):
+        agg = per_ka.get((kind, axis), {})
+        gbps = agg.get("achieved_gbps")
+        out.write(
+            "#   %-13s %4s  %6d  %10d  %10d  %6d  %13s\n" % (
+                kind, axis, tot["issues"], tot["payload_bytes"],
+                tot["wire_bytes"], agg.get("events", 0),
+                ("%.2f" % gbps) if gbps is not None else "n/a",
+            )
+        )
+    for kind, d in sorted(join.unmatched.items()):
+        out.write("#   unmatched %-10s events %d (no ledger entry)\n"
+                  % (kind, d["events"]))
+    if join.ragged:
+        out.write("#   ragged kinds (event count not a multiple of "
+                  f"issues): {', '.join(join.ragged)}\n")
+    if join.no_device_track:
+        out.write(
+            "# no device track in trace (platform records host events "
+            "only) — achieved-bandwidth matrix unavailable\n"
+        )
+        out.flush()
+        return
+    rep = render_matrix(
+        join.link_matrix(n),
+        f"Evaluating the {title} TPU P2P Achieved Bandwidth (Gbps)",
+        stream=out,
+    )
+    rep.print_summary("ledger per-link achieved")
+    out.flush()

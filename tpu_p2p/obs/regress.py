@@ -1,0 +1,311 @@
+"""Regression gate + the ``python -m tpu_p2p obs`` entry point.
+
+CI half of the observability layer: load the repo's bench trajectory
+(``BENCH_r*.json`` driver artifacts + ``BASELINE.json``), compare a
+*current* headline against it with per-key tolerances, print a verdict
+table, and exit nonzero on regression — so a round that quietly gives
+back the overlap/MFU wins fails the gate instead of shipping.
+
+Artifact formats understood (the driver's format changed mid-history):
+
+- rounds 1-4: ``parsed`` holds the full result dict; headline keys
+  live under ``parsed["detail"]``.
+- round 5: ``parsed`` is null (the compact-line truncation failure
+  this repo's PR 1 fixed) — headline keys are regex-recovered from
+  the stdout ``tail`` fragment, last occurrence wins.
+- round 6+: ``parsed`` holds the compact line; keys live under
+  ``parsed["headline"]``.
+- ``--current`` may also point at a ``BENCH_detail.json`` (keys under
+  ``detail``) or a raw compact line file.
+
+Comparison rule, per key in :data:`TOLERANCES`: the reference is the
+BEST prior value (max for higher-better, min for lower-better — a
+noisy prior round must not ratchet the bar down), and the current
+value regresses when it is worse than ``rel`` beyond that reference.
+Keys missing from the current artifact or from every prior are SKIP,
+never a failure: headline keys accrete round over round by design.
+
+``python -m tpu_p2p obs`` first prints the LIVE obs report — the
+collective-ledger capture on the current mesh
+(:func:`tpu_p2p.obs.ledger.live_capture`: ring ppermute + all-gather
+chains under a fresh ledger + profiler trace, joined into the
+per-link achieved-bandwidth matrix; ledger totals only on platforms
+recording no device track) — then runs the gate. ``--no-live`` /
+``--no-gate`` select one half.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Tolerance", "TOLERANCES", "headline_from_artifact",
+           "load_trajectory", "compare", "main"]
+
+
+@dataclass(frozen=True)
+class Tolerance:
+    better: str  # "higher" | "lower"
+    rel: float  # allowed fractional regression vs the best prior
+
+
+# Per-key gate tolerances. rel is deliberately loose where the
+# measurement rides session noise (latency floors through the relay)
+# and tight where the device-trace slope is stable (MFU, step time).
+TOLERANCES: Dict[str, Tolerance] = {
+    "hbm_gbytes_per_s": Tolerance("higher", 0.15),
+    "flash_attention_tflops": Tolerance("higher", 0.15),
+    "flash_bwd_tflops": Tolerance("higher", 0.15),
+    "flagship_step_ms": Tolerance("lower", 0.20),
+    "flagship_large_step_ms": Tolerance("lower", 0.15),
+    "flagship_large_mfu": Tolerance("higher", 0.10),
+    "flagship_large_tokens_per_s": Tolerance("higher", 0.15),
+    "latency_8b_p50_us": Tolerance("lower", 0.50),
+    "latency_8b_oneop_p50_us": Tolerance("lower", 0.50),
+    "decode_ms_per_token": Tolerance("lower", 0.25),
+    "decode_hbm_ms_per_token": Tolerance("lower", 0.20),
+    "fsdp_overlap_frac": Tolerance("higher", 0.25),
+    "fsdp_step_ms_overlap_prefetch": Tolerance("lower", 0.25),
+    "tp_overlap_frac": Tolerance("higher", 0.25),
+    "tp_step_ms_overlap_ring": Tolerance("lower", 0.25),
+    # PR 3 obs keys (bench.py _obs_metrics).
+    "ring_achieved_gbps": Tolerance("higher", 0.25),
+    "ag_achieved_gbps": Tolerance("higher", 0.25),
+    "obs_step_ms_p50": Tolerance("lower", 0.30),
+}
+
+_TAIL_KV = re.compile(
+    r'"([A-Za-z0-9_]+)":\s*(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)'
+)
+
+
+def _numeric(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _headline_from_tail(tail: str) -> Dict[str, float]:
+    """Regex-recover gate keys from a (possibly truncated) stdout
+    tail — the only record a ``parsed: null`` round left behind. Last
+    occurrence wins (the final line supersedes progress chatter)."""
+    out: Dict[str, float] = {}
+    for m in _TAIL_KV.finditer(tail or ""):
+        if m.group(1) in TOLERANCES:
+            out[m.group(1)] = float(m.group(2))
+    return out
+
+
+def headline_from_artifact(data: dict) -> Dict[str, float]:
+    """Flatten one artifact (any of the formats in the module
+    docstring) to ``{gate_key: value}``, numeric values only."""
+    out: Dict[str, float] = {}
+    candidates: List[dict] = []
+    if isinstance(data.get("parsed"), dict):
+        parsed = data["parsed"]
+        for sub in ("detail", "headline"):
+            if isinstance(parsed.get(sub), dict):
+                candidates.append(parsed[sub])
+        candidates.append(parsed)
+    elif "parsed" in data:  # driver artifact with parsed: null
+        return _headline_from_tail(data.get("tail", ""))
+    # BENCH_detail.json / compact-line dicts passed via --current.
+    for sub in ("detail", "headline", "published"):
+        if isinstance(data.get(sub), dict):
+            candidates.append(data[sub])
+    if not candidates:
+        candidates.append(data)
+    for cand in candidates:
+        for k in TOLERANCES:
+            if k not in out and _numeric(cand.get(k)):
+                out[k] = float(cand[k])
+    return out
+
+
+def load_trajectory(artifacts_dir: str,
+                    current: Optional[str] = None):
+    """→ ``(current_name, current_headline, priors)`` where ``priors``
+    is ``[(name, headline), ...]`` in round order.
+
+    ``BENCH_r*.json`` files sort by round; ``current`` (a path or bare
+    filename) defaults to the newest. Rounds after the chosen current
+    are ignored (gating an old round replays history, it does not see
+    the future). ``BASELINE.json``'s ``published`` dict, when
+    non-empty, joins the priors as the round-0 anchor.
+    """
+    rounds = sorted(glob.glob(os.path.join(artifacts_dir,
+                                           "BENCH_r*.json")))
+    cur_path = None
+    if current:
+        # A bare filename resolves under artifacts_dir first — the
+        # trajectory and its current must come from the same place;
+        # an explicit path (or a name absent there) is honored as-is.
+        in_dir = os.path.join(artifacts_dir, current)
+        cur_path = (in_dir if os.path.sep not in current
+                    and os.path.exists(in_dir) else current)
+    elif rounds:
+        cur_path = rounds[-1]
+    if cur_path is None or not os.path.exists(cur_path):
+        raise FileNotFoundError(
+            f"no current artifact (looked for BENCH_r*.json under "
+            f"{artifacts_dir!r}" + (f" and {current!r}" if current
+                                    else "") + ")"
+        )
+    with open(cur_path) as fh:
+        cur_head = headline_from_artifact(json.load(fh))
+    cur_name = os.path.basename(cur_path)
+    # Future-round exclusion compares BASENAMES: an explicit --current
+    # path may spell the same file differently than the glob, and the
+    # gate must still replay history (priors strictly before the
+    # gated round), not see the future.
+    cur_is_round = any(os.path.basename(p) == cur_name for p in rounds)
+    priors: List[Tuple[str, Dict[str, float]]] = []
+    base = os.path.join(artifacts_dir, "BASELINE.json")
+    if os.path.exists(base):
+        with open(base) as fh:
+            pub = headline_from_artifact(json.load(fh))
+        if pub:
+            priors.append(("BASELINE.json", pub))
+    for p in rounds:
+        name = os.path.basename(p)
+        if name == cur_name or (cur_is_round and name >= cur_name):
+            continue
+        with open(p) as fh:
+            head = headline_from_artifact(json.load(fh))
+        if head:
+            priors.append((name, head))
+    return cur_name, cur_head, priors
+
+
+def compare(current: Dict[str, float],
+            priors: Sequence[Tuple[str, Dict[str, float]]]):
+    """→ list of row dicts: key, current, ref (best prior), ratio,
+    verdict in {"OK", "REGRESSED", "SKIP"}."""
+    rows = []
+    for key, tol in TOLERANCES.items():
+        cur = current.get(key)
+        vals = [h[key] for _, h in priors
+                if _numeric(h.get(key))]
+        if cur is None or not vals:
+            rows.append({"key": key, "current": cur, "ref": None,
+                         "ratio": None, "verdict": "SKIP"})
+            continue
+        ref = max(vals) if tol.better == "higher" else min(vals)
+        ratio = (cur / ref) if ref else None
+        if tol.better == "higher":
+            bad = ref > 0 and cur < ref * (1.0 - tol.rel)
+        else:
+            bad = ref > 0 and cur > ref * (1.0 + tol.rel)
+        rows.append({"key": key, "current": cur, "ref": ref,
+                     "ratio": ratio,
+                     "verdict": "REGRESSED" if bad else "OK"})
+    return rows
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    return f"{v:.4g}" if isinstance(v, float) else str(v)
+
+
+def print_gate(cur_name: str, rows, priors, stream=None) -> int:
+    """Print the verdict table; → process exit code (1 on any
+    REGRESSED row)."""
+    out = stream if stream is not None else sys.stdout
+    out.write(f"# obs regress: current={cur_name} vs "
+              f"{len(priors)} prior artifact(s)\n")
+    out.write("# %-30s %10s %10s %7s  %s\n"
+              % ("key", "current", "ref", "ratio", "verdict"))
+    for r in rows:
+        out.write("# %-30s %10s %10s %7s  %s\n" % (
+            r["key"], _fmt(r["current"]), _fmt(r["ref"]),
+            _fmt(r["ratio"]), r["verdict"],
+        ))
+    n_reg = sum(r["verdict"] == "REGRESSED" for r in rows)
+    n_ok = sum(r["verdict"] == "OK" for r in rows)
+    n_skip = sum(r["verdict"] == "SKIP" for r in rows)
+    out.write(f"# verdict: {'REGRESSED' if n_reg else 'OK'} "
+              f"({n_reg} regressions, {n_ok} keys compared, "
+              f"{n_skip} skipped)\n")
+    out.flush()
+    return 1 if n_reg else 0
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_p2p obs",
+        description="Observability report + bench regression gate: "
+                    "live collective-ledger capture on the current "
+                    "mesh, then the BENCH_r*.json trajectory gate.",
+    )
+    p.add_argument("--artifacts-dir", default=".", metavar="DIR",
+                   help="where BENCH_r*.json / BASELINE.json live "
+                        "(default: cwd)")
+    p.add_argument("--current", default=None, metavar="PATH",
+                   help="artifact to gate (default: newest BENCH_r*); "
+                        "also accepts a BENCH_detail.json or a raw "
+                        "compact-line file")
+    p.add_argument("--msg-size", default="4MiB", metavar="SIZE",
+                   help="live-capture payload per message")
+    p.add_argument("--count", type=int, default=8,
+                   help="live-capture chain hops")
+    p.add_argument("--no-live", action="store_true",
+                   help="skip the live ledger capture/report")
+    p.add_argument("--no-gate", action="store_true",
+                   help="skip the trajectory gate")
+    p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
+                   help="testing: force CPU platform with N simulated "
+                        "devices")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    from tpu_p2p.utils.errors import fail_fast
+
+    try:
+        if not args.no_live:
+            if args.cpu_mesh:
+                from tpu_p2p.cli import _force_cpu_mesh
+
+                _force_cpu_mesh(args.cpu_mesh)
+            from tpu_p2p.config import parse_size
+            from tpu_p2p.obs import ledger as L
+            from tpu_p2p.parallel.runtime import make_runtime
+
+            rt = make_runtime()
+            n = rt.num_devices
+            print(f"# obs live capture: {n} device(s), "
+                  f"{args.msg_size} payload, {args.count}-hop chains")
+            led, join = L.live_capture(
+                rt.mesh, msg_bytes=parse_size(args.msg_size),
+                count=args.count,
+            )
+            if n < 2:
+                print("# single device: no inter-chip link exists — "
+                      "ledger capture skipped")
+            else:
+                L.print_report(led, join, n=n)
+        rc = 0
+        if not args.no_gate:
+            cur_name, cur_head, priors = load_trajectory(
+                args.artifacts_dir, args.current
+            )
+            rows = compare(cur_head, priors)
+            rc = print_gate(cur_name, rows, priors)
+        return rc
+    except KeyboardInterrupt:
+        print("interrupted", file=sys.stderr)
+        return 130
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 — single fail-fast (L8)
+        return fail_fast(e)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,174 @@
+"""Step timeline: span-based host-side structured step telemetry.
+
+MegaScale-style production training (Jiang et al., 2024 — PAPERS.md)
+treats the per-step timeline as the primary debugging surface; this
+module gives ``tpu_p2p.train`` one. A :class:`StepTimeline` wraps the
+training loop's phases in named spans and emits one JSONL record per
+step through the trainer's existing ``emit`` path (behind
+``--obs-jsonl``):
+
+    {"obs": "step", "step": 7, "step_ms": 12.3,
+     "spans": {"data": 0.4, "step": 11.6, "checkpoint": 0.3}}
+
+Span kinds are an open set; the trainer emits what its loop can
+honestly separate — ``data`` (host batch fetch), ``step`` (dispatch +
+device execution: forward, backward and optimizer are ONE fused XLA
+program in this framework, so a host-side split of them would be
+fiction), ``eval``, ``checkpoint``. The device-side split of a step
+lives in the trace join instead (:mod:`tpu_p2p.obs.ledger` per-kind
+collective time, ``profiling.op_category_breakdown`` compute
+categories) — measured where it happens, not guessed from the host.
+
+Device correlation: :func:`device_window_record` turns one sampled
+``jax.profiler.trace`` capture of a step into a
+``{"obs": "device_window"}`` record carrying the device-busy
+fraction, the FSDP/tp overlap fractions, and the ledger join's
+per-kind achieved bandwidth; the trainer also folds the fractions
+into that step's own row (the "step row carries device-busy and
+overlap fractions" contract — tracing is heavy, so one sampled window
+per run, not every step). On platforms recording no device track (the
+simulated CPU mesh) every device field is an explicit null.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["SPAN_KINDS", "StepTimeline", "device_window_record"]
+
+# Documented span vocabulary (open set — emitters may add kinds, but
+# these names are the schema consumers can rely on).
+SPAN_KINDS = ("data", "gather", "forward", "backward", "optimizer",
+              "step", "eval", "checkpoint")
+
+
+class StepTimeline:
+    """Accumulates named host-side spans per step; emits JSONL rows.
+
+    ``emit``: callable taking one JSON-ready dict (the trainer's
+    ``emit`` closure). Spans within one step accumulate (two ``data``
+    spans in a step sum into one ``data`` entry); ``end_step`` emits
+    the row and resets. ``step_ms`` is wall time from the step's first
+    span start to the ``end_step`` call — the loop's real cadence,
+    including any host work between spans.
+    """
+
+    def __init__(self, emit: Callable[[dict], None],
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._emit = emit
+        self._clock = clock
+        self._spans: Dict[str, float] = {}
+        self._t0: Optional[float] = None
+        self.step_ms_history: List[float] = []
+
+    @contextmanager
+    def span(self, name: str):
+        t0 = self._clock()
+        if self._t0 is None:
+            self._t0 = t0
+        try:
+            yield
+        finally:
+            self._spans[name] = (self._spans.get(name, 0.0)
+                                 + self._clock() - t0)
+
+    def end_step(self, step: int, extra: Optional[dict] = None) -> dict:
+        """Emit this step's row and reset the span accumulator."""
+        now = self._clock()
+        step_ms = (now - self._t0) * 1e3 if self._t0 is not None else 0.0
+        rec = {
+            "obs": "step",
+            "step": int(step),
+            "step_ms": round(step_ms, 3),
+            "spans": {k: round(v * 1e3, 3)
+                      for k, v in sorted(self._spans.items())},
+        }
+        if extra:
+            rec.update(extra)
+        self._spans = {}
+        self._t0 = None
+        self.step_ms_history.append(step_ms)
+        self._emit(rec)
+        return rec
+
+    def p50_step_ms(self) -> Optional[float]:
+        """p50 of emitted step rows' wall times — skipping the first
+        step (it carries compilation) when more than two steps ran."""
+        h = self.step_ms_history
+        if not h:
+            return None
+        if len(h) > 2:
+            h = h[1:]
+        return round(float(statistics.median(h)), 3)
+
+    def summary_record(self) -> dict:
+        return {
+            "obs": "summary",
+            "steps": len(self.step_ms_history),
+            "obs_step_ms_p50": self.p50_step_ms(),
+        }
+
+
+def device_window_record(trace_dir: str, *, step: Optional[int] = None,
+                         ledger=None) -> dict:
+    """One sampled device-trace window → a JSONL-ready record.
+
+    Correlates the host timeline to the device timeline for one traced
+    step: device-busy fraction
+    (:func:`tpu_p2p.utils.profiling.device_busy_fraction`), the FSDP
+    gather and tp collective-permute overlap fractions (the metrics
+    ``bench.py`` grades), and — when a :class:`~tpu_p2p.obs.ledger.
+    CollectiveLedger` is passed — the trace join's per-kind achieved
+    bandwidth. Every device field is null when the platform records no
+    device track, and the record says so (``device_track``).
+    """
+    from tpu_p2p.utils.profiling import (
+        device_busy_fraction,
+        gather_overlap_fraction,
+        tp_overlap_fraction,
+    )
+
+    busy = device_busy_fraction(trace_dir)
+    rec: dict = {
+        "obs": "device_window",
+        "step": step,
+        "device_track": busy is not None,
+        "device_busy_frac": None,
+        "device_span_ms": None,
+        "gather_overlap_frac": None,
+        "tp_overlap_frac": None,
+    }
+    if busy is not None:
+        rec["device_busy_frac"] = (
+            round(busy["frac"], 4) if busy["frac"] is not None else None
+        )
+        rec["device_span_ms"] = round(busy["span_s"] * 1e3, 3)
+        ov = gather_overlap_fraction(trace_dir)
+        if ov is not None and ov["frac"] is not None:
+            rec["gather_overlap_frac"] = round(ov["frac"], 4)
+        tv = tp_overlap_fraction(trace_dir)
+        if tv is not None and tv["frac"] is not None:
+            rec["tp_overlap_frac"] = round(tv["frac"], 4)
+    if ledger is not None:
+        from tpu_p2p.obs.ledger import join_trace
+
+        join = join_trace(ledger, trace_dir)
+        rec["collectives"] = {
+            kind: {
+                "events": d["events"],
+                "wire_bytes": d["wire_bytes"],
+                "seconds": round(d["seconds"], 6),
+                "achieved_gbps": (round(d["achieved_gbps"], 3)
+                                  if d["achieved_gbps"] is not None
+                                  else None),
+            }
+            for kind, d in sorted(join.per_kind().items())
+        }
+        rec["ledger_issues"] = len(ledger)
+        rec["unmatched_collective_events"] = sum(
+            int(d["events"]) for d in join.unmatched.values()
+        )
+    return rec

@@ -35,6 +35,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# Observability hook (tpu_p2p/obs/ledger.py): every collective issued
+# below records (kind, axis, participants, aval bytes) into the active
+# ledger. Recording happens at trace time — one host-side append per
+# collective per compilation, a single truthiness check when no ledger
+# records (the default). The obs package keeps its module scope free
+# of parallel/models imports, so this upward import cannot cycle.
+from tpu_p2p.obs.ledger import aval_bytes as _aval_bytes
+from tpu_p2p.obs.ledger import record_issue as _record_issue
+
 Edge = Tuple[int, int]
 
 # Multiplicative rank tag; coprime with 256 so per-rank patterns are
@@ -259,6 +268,8 @@ def bucketed_all_gather(shards, axis: str, bucket_bytes=None):
             flat = (bucket[0][1].reshape(-1) if len(bucket) == 1
                     else jnp.concatenate(_promote_vma(
                         [v.reshape(-1) for _, v, _ in bucket])))
+            _record_issue("all_gather", axis, nbytes=_aval_bytes(flat),
+                          axis_size=n, label="bucketed_all_gather")
             rows = jax.lax.all_gather(flat, axis)  # [n, sum(sizes)]
             off = 0
             for k, v, d in bucket:
@@ -312,6 +323,10 @@ def ring_allgather_matmul(compute_chunk: Callable, x_shard, axis: str,
         return compute_chunk(x_shard, 0)
     idx = jax.lax.axis_index(axis)
     fwd = [(j, (j + 1) % n) for j in range(n)]
+    # n-1 shift-by-1 hops, each carrying the full chunk per link.
+    _record_issue("ppermute", axis, nbytes=_aval_bytes(x_shard),
+                  axis_size=n, edges=fwd, count=n - 1,
+                  label="ring_allgather_matmul")
     cur, src, out = x_shard, idx, None
     for s in range(n):
         # Issue the next hop BEFORE consuming cur: the transfer has no
@@ -375,6 +390,10 @@ def matmul_ring_reducescatter(compute_chunk: Callable, x, axis: str,
 
     rev = [(j, (j - 1) % n) for j in range(n)]
     acc = part((idx + 1) % n)
+    # n-1 reverse-ring hops of the accumulator (one chunk per link).
+    _record_issue("ppermute", axis, nbytes=_aval_bytes(acc),
+                  axis_size=n, edges=rev, count=n - 1,
+                  label="matmul_ring_reducescatter")
     for s in range(1, n):
         # The accumulator's hop has no data dependency on this step's
         # partial matmul — XLA overlaps the two.
@@ -451,6 +470,9 @@ class CollectiveCache:
             spec = P(*mesh.axis_names, None)
 
             def f(x):
+                _record_issue("ppermute", axis, nbytes=_aval_bytes(x),
+                              axis_size=mesh.shape[axis], edges=edges,
+                              label="permute")
                 return jax.lax.ppermute(x, axis, edges)
 
             return jax.jit(
@@ -477,6 +499,12 @@ class CollectiveCache:
             spec = P(*mesh.axis_names, None)
 
             def f(x):
+                # Recorded once with count=len(scan): the scan body is
+                # traced once but executes `count` hops on the device.
+                _record_issue("ppermute", axis, nbytes=_aval_bytes(x),
+                              axis_size=mesh.shape[axis], edges=edges,
+                              count=count, label="permute_chain")
+
                 def step(carry, _):
                     return jax.lax.ppermute(carry, axis, edges), None
 
@@ -558,6 +586,9 @@ class CollectiveCache:
 
             def f(x):
                 # x local: (1, ..., elems); exchange along payload dim.
+                _record_issue("all_to_all", axis, nbytes=_aval_bytes(x),
+                              axis_size=mesh.shape[axis],
+                              label="all_to_all")
                 return jax.lax.all_to_all(
                     x, axis, split_axis=x.ndim - 1, concat_axis=x.ndim - 1, tiled=True
                 )
@@ -582,6 +613,9 @@ class CollectiveCache:
             spec = P(*mesh.axis_names, None)
 
             def f(x):
+                _record_issue("all_reduce", axis, nbytes=_aval_bytes(x),
+                              axis_size=mesh.shape[axis],
+                              label="all_reduce")
                 return jax.lax.psum(x, axis)
 
             return jax.jit(
@@ -600,6 +634,10 @@ class CollectiveCache:
             spec = P(*mesh.axis_names, None)
 
             def f(x):
+                _record_issue("all_reduce", axis, nbytes=_aval_bytes(x),
+                              axis_size=mesh.shape[axis], count=count,
+                              label="psum_chain")
+
                 def step(carry, _):
                     # psum output is typed unvarying over `axis`; the
                     # recast keeps the scan carry type fixed.
@@ -626,6 +664,10 @@ class CollectiveCache:
             spec = P(*mesh.axis_names, None)
 
             def f(x):
+                _record_issue("reduce_scatter", axis,
+                              nbytes=_aval_bytes(x),
+                              axis_size=mesh.shape[axis],
+                              label="reduce_scatter")
                 return jax.lax.psum_scatter(
                     x, axis, scatter_dimension=x.ndim - 1, tiled=True
                 )
@@ -647,6 +689,14 @@ class CollectiveCache:
             spec = P(*mesh.axis_names, None)
 
             def f(x):
+                n = mesh.shape[axis]
+                _record_issue("reduce_scatter", axis,
+                              nbytes=_aval_bytes(x), axis_size=n,
+                              count=count, label="rs_ag_chain")
+                _record_issue("all_gather", axis,
+                              nbytes=_aval_bytes(x) // n, axis_size=n,
+                              count=count, label="rs_ag_chain")
+
                 def step(carry, _):
                     rs = jax.lax.psum_scatter(
                         carry, axis, scatter_dimension=carry.ndim - 1,
@@ -686,6 +736,9 @@ class CollectiveCache:
                 own = jax.lax.dynamic_slice_in_dim(
                     x, jax.lax.axis_index(axis) * c, c, x.ndim - 1
                 )
+                _record_issue("all_gather", axis,
+                              nbytes=_aval_bytes(own), axis_size=n,
+                              label="all_gather")
                 return jax.lax.all_gather(
                     own, axis, axis=own.ndim - 1, tiled=True
                 )
@@ -710,6 +763,10 @@ class CollectiveCache:
             def f(x):
                 c = x.shape[-1] // n
                 idx = jax.lax.axis_index(axis) * c
+                _record_issue("all_gather", axis,
+                              nbytes=_aval_bytes(x) // x.shape[-1] * c,
+                              axis_size=n, count=count,
+                              label="ag_chain")
 
                 def step(carry, _):
                     own = jax.lax.dynamic_slice_in_dim(

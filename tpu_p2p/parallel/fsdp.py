@@ -88,6 +88,19 @@ def all_gather_params(params: Dict[str, jax.Array], axis: str,
     """Rebuild full parameters from dp shards — call *inside* the
     ``shard_map``-ed, differentiated step so the transpose becomes the
     ZeRO gradient ``psum_scatter``."""
+    from tpu_p2p.obs import ledger as _obs
+
+    if _obs.active() is not None and any(
+        plan.get(k) is not None for k in params
+    ):
+        # Obs ledger (tpu_p2p/obs/ledger.py): one all-gather issue per
+        # planned leaf, bytes from the shard aval — trace-time only.
+        n = jax.lax.axis_size(axis)
+        for k, v in params.items():
+            if plan.get(k) is not None:
+                _obs.record_issue(
+                    "all_gather", axis, nbytes=_obs.aval_bytes(v),
+                    axis_size=n, label=f"fsdp.all_gather_params:{k}")
     return {
         k: (jax.lax.all_gather(v, axis, axis=plan[k], tiled=True)
             if plan.get(k) is not None else v)

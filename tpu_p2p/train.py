@@ -97,7 +97,8 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
                  log_stream=None, optimizer: str = "sgd",
                  weight_decay: float = 0.0, eval_every: int = 0,
                  eval_batches: int = 2, clip_norm: float = 0.0,
-                 warmup_steps: int = 0, schedule: str = "constant") -> dict:
+                 warmup_steps: int = 0, schedule: str = "constant",
+                 obs_jsonl: Optional[str] = None) -> dict:
     """Train the flagship for ``steps`` global steps; returns a summary
     dict (``final_loss``, ``steps_run``, ``start_step``, ...).
 
@@ -113,6 +114,20 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
     ``eval_every=N`` evaluates the loss on a fixed held-out batch set
     (a disjoint seed stream) every N steps, emitting ``eval_loss``
     records to the same log.
+    ``obs_jsonl=PATH`` enables the observability layer
+    (docs/observability.md): one span-timed JSONL row per step
+    (:class:`tpu_p2p.obs.timeline.StepTimeline` — data/step/eval/
+    checkpoint spans through the same ``emit`` machinery as the
+    training log), a collective ledger recording every
+    ``collectives.py``/``fsdp.py`` issue at step-compile time, one
+    sampled ``jax.profiler.trace`` window (the second executed step,
+    past compilation) joined into a ``device_window`` record carrying
+    device-busy/overlap fractions and per-kind achieved collective
+    bandwidth, and a closing ``summary`` record with
+    ``obs_step_ms_p50`` (also returned in the summary dict). Obs mode
+    blocks on the loss every step so ``step_ms`` is real step cadence,
+    not dispatch time — observability costs one sync per step and the
+    records say so by existing.
     """
     import jax
     from jax.sharding import NamedSharding
@@ -277,13 +292,23 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
     loader = DeviceLoader(_per_step_batches(cfg, seed, start_step), mesh,
                           data_spec, prefetch=2)
 
-    def emit(rec):
+    def _emit_to(path, rec):
         line = json.dumps(rec)
         if log_stream is not None:
             print(line, file=log_stream, flush=True)
-        if log_path:
-            with open(log_path, "a") as fh:
+        if path:
+            with open(path, "a") as fh:
                 fh.write(line + "\n")
+
+    def emit(rec):
+        _emit_to(log_path, rec)
+
+    def emit_obs(rec):
+        # Obs records ride the same emit machinery (stream included)
+        # but land in their own file: the training log's record schema
+        # (step/loss/eval_loss — pinned in tests/test_trainer.py) must
+        # not grow implicit new shapes.
+        _emit_to(obs_jsonl, rec)
 
     def save_ckpt(step_no):
         C.save_params(ckpt_dir, params, step=step_no)
@@ -300,44 +325,115 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
             if os.path.exists(sp):
                 os.remove(sp)
 
+    import contextlib
+
+    tl = led = None
+    obs_trace_step = None
+    if obs_jsonl:
+        from tpu_p2p.obs import ledger as obs_ledger
+        from tpu_p2p.obs.timeline import StepTimeline, device_window_record
+
+        tl = StepTimeline(emit_obs)
+        led = obs_ledger.CollectiveLedger()
+        # One sampled device-trace window per run (tracing every step
+        # is the kind of overhead observability must not add): the
+        # SECOND executed step — the first carries XLA compilation.
+        obs_trace_step = (start_step + 1 if steps - start_step > 1
+                          else start_step)
+
+    def _span(name):
+        return (tl.span(name) if tl is not None
+                else contextlib.nullcontext())
+
     t0 = time.monotonic()
     tokens_per_step = cfg.batch * cfg.seq
     loss = None
     saved_at = start_step - 1
-    for step in range(start_step, steps):
-        x, t = next(loader)
-        if opt_state is not None:
-            params, opt_state, loss = step_fn(params, opt_state, x, t)
-        else:
-            params, loss = step_fn(params, x, t)
-        if log_every and ((step + 1) % log_every == 0 or step + 1 == steps):
-            dt = time.monotonic() - t0
-            emit({
-                "step": step + 1,
-                "loss": round(float(loss), 6),  # device sync on log steps
-                "wall_s": round(dt, 3),
-                "tokens_per_s_wall": round(
-                    (step + 1 - start_step) * tokens_per_step / dt
-                ),
-            })
-        if eval_every and eval_fn and (step + 1) % eval_every == 0:
-            ev = float(np.mean([float(eval_fn(params, xe, te))
-                                for xe, te in eval_set]))
-            emit({"step": step + 1, "eval_loss": round(ev, 6)})
-        if ckpt_every and ckpt_dir and (step + 1) % ckpt_every == 0:
-            save_ckpt(step + 1)
-            saved_at = step + 1
+    with contextlib.ExitStack() as _obs_stack:
+        if led is not None:
+            # Recording wraps the loop so the first step's trace (the
+            # compile) records every collectives.py/fsdp.py issue.
+            from tpu_p2p.obs import ledger as obs_ledger
+
+            _obs_stack.enter_context(obs_ledger.recording(led))
+        for step in range(start_step, steps):
+            with _span("data"):
+                x, t = next(loader)
+            td_obs = None
+            with _span("step"):
+                if tl is not None and step == obs_trace_step:
+                    import tempfile
+
+                    td_obs = tempfile.mkdtemp(prefix="obs_step_")
+                    cm = jax.profiler.trace(td_obs)
+                else:
+                    cm = contextlib.nullcontext()
+                with cm:
+                    if opt_state is not None:
+                        params, opt_state, loss = step_fn(
+                            params, opt_state, x, t)
+                    else:
+                        params, loss = step_fn(params, x, t)
+                    if tl is not None:
+                        # Obs mode syncs every step: step_ms must be
+                        # the step's real cadence, not dispatch time.
+                        jax.block_until_ready(loss)
+            dev_rec = None
+            if td_obs is not None:
+                import shutil
+
+                dev_rec = device_window_record(td_obs, step=step + 1,
+                                               ledger=led)
+                shutil.rmtree(td_obs, ignore_errors=True)
+            if log_every and ((step + 1) % log_every == 0
+                              or step + 1 == steps):
+                dt = time.monotonic() - t0
+                emit({
+                    "step": step + 1,
+                    "loss": round(float(loss), 6),  # device sync on log steps
+                    "wall_s": round(dt, 3),
+                    "tokens_per_s_wall": round(
+                        (step + 1 - start_step) * tokens_per_step / dt
+                    ),
+                })
+            if eval_every and eval_fn and (step + 1) % eval_every == 0:
+                with _span("eval"):
+                    ev = float(np.mean([float(eval_fn(params, xe, te))
+                                        for xe, te in eval_set]))
+                emit({"step": step + 1, "eval_loss": round(ev, 6)})
+            if ckpt_every and ckpt_dir and (step + 1) % ckpt_every == 0:
+                with _span("checkpoint"):
+                    save_ckpt(step + 1)
+                saved_at = step + 1
+            if tl is not None:
+                extra = {}
+                if dev_rec is not None:
+                    # The traced step's own row carries the device
+                    # correlation (the full join rides the separate
+                    # device_window record below).
+                    extra = {k: dev_rec[k] for k in
+                             ("device_busy_frac", "gather_overlap_frac",
+                              "tp_overlap_frac")}
+                tl.end_step(step + 1, extra=extra)
+                if dev_rec is not None:
+                    emit_obs(dev_rec)
     ran = max(0, steps - start_step)
     if ran and ckpt_dir and saved_at != steps:  # rolling save may have
         # already written this exact state — don't gather it twice
         save_ckpt(steps)
     final = round(float(loss), 6) if loss is not None else None
-    return {
+    out = {
         "start_step": start_step,
         "steps_run": ran,
         "final_loss": final,
         "params": params,
     }
+    if tl is not None:
+        summary = tl.summary_record()
+        emit_obs(summary)
+        out["obs_step_ms_p50"] = summary["obs_step_ms_p50"]
+        out["obs_ledger_issues"] = len(led)
+    return out
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -351,6 +447,11 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--log-jsonl", default=None, metavar="PATH")
+    p.add_argument("--obs-jsonl", default=None, metavar="PATH",
+                   help="observability JSONL (docs/observability.md): "
+                        "span-timed step rows, a sampled device-trace "
+                        "window with collective-ledger join, and an "
+                        "obs_step_ms_p50 summary; syncs every step")
     p.add_argument("--ckpt-dir", default=None, metavar="DIR")
     p.add_argument("--ckpt-every", type=int, default=0, metavar="N")
     p.add_argument("--resume", action="store_true",
@@ -433,7 +534,7 @@ def main(argv=None) -> int:
         optimizer=args.optimizer, weight_decay=args.weight_decay,
         eval_every=args.eval_every, eval_batches=args.eval_batches,
         clip_norm=args.clip_norm, warmup_steps=args.warmup_steps,
-        schedule=args.schedule,
+        schedule=args.schedule, obs_jsonl=args.obs_jsonl,
     )
     summary.pop("params")
     print(json.dumps({"summary": summary}))

@@ -40,6 +40,8 @@ __all__ = [
     "load_trace_events",
     "device_top_level_events",
     "device_leaf_events",
+    "device_collective_intervals",
+    "device_busy_fraction",
     "differential_from_trace",
     "gather_overlap_fraction",
     "tp_overlap_fraction",
@@ -370,6 +372,103 @@ def _intersect_len(a, b) -> float:
     return total
 
 
+def _bridged_intervals(dev_evs, pid0: int, is_match):
+    """Matching device events as bridged ``(name, t0, t1)`` intervals.
+
+    The ONE implementation of the async-pair rule (shared by
+    :func:`gather_overlap_fraction` and
+    :func:`device_collective_intervals`, so the overlap fractions and
+    the obs ledger's join can never disagree about what an interval
+    is): XLA's ``*-start.N`` / ``*-done.N`` pairs bridge into one
+    interval spanning start-begin → done-end — the in-flight gap
+    between them IS the transfer — paired by the done-name after
+    ts-sorting (Chrome-trace event order is not guaranteed, so the
+    sort makes a pair's start always precede its done). Unpaired
+    starts keep their own span; only ``pid0``'s events count.
+    """
+    starts: dict = {}
+    out = []
+    for e in sorted(dev_evs, key=lambda e: e["ts"]):
+        name = e.get("name", "")
+        if e["pid"] != pid0 or not is_match(name):
+            continue
+        t0, t1 = e["ts"] / 1e6, (e["ts"] + e["dur"]) / 1e6
+        if "start" in name:
+            starts[name.replace("start", "done")] = (name, t0, t1)
+        elif name in starts:
+            sname, s0, _ = starts.pop(name)
+            out.append((sname, s0, t1))
+        else:
+            out.append((name, t0, t1))
+    out.extend(starts.values())  # unpaired starts: own span only
+    return out
+
+
+def device_collective_intervals(trace_dir: str, window=None,
+                                loaded=None):
+    """Collective device events as bridged intervals →
+    ``[(name, t0, t1), ...]`` sorted by start, seconds units — the
+    trace-side input of the obs ledger's join
+    (:func:`tpu_p2p.obs.ledger.join_trace`).
+
+    Same event selection rules as :func:`gather_overlap_fraction`
+    (shared :func:`_bridged_intervals`): lowest device pid only
+    (multi-device traces repeat every program per track), async pairs
+    bridged. An event counts as collective when :func:`categorize_op`
+    says so. ``window``: optional ``(t0, t1)`` seconds filter
+    (whole-interval containment). Returns ``None`` when the platform
+    records no device track (the simulated CPU mesh) — distinct from
+    a device trace that simply holds no collectives (empty list).
+    """
+    xs, pid_names = (load_trace_events(trace_dir) if loaded is None
+                     else loaded)
+    dev_pids = {p for p, n in pid_names.items()
+                if str(n).startswith("/device:")}
+    dev_evs = [e for e in xs if e.get("pid") in dev_pids]
+    if not dev_evs:
+        return None
+    pid0 = min(e["pid"] for e in dev_evs)
+    out = _bridged_intervals(
+        dev_evs, pid0, lambda name: categorize_op(name) == "collective"
+    )
+    if window is not None:
+        w0, w1 = window
+        out = [(n, t0, t1) for n, t0, t1 in out if w0 <= t0 and t1 <= w1]
+    out.sort(key=lambda r: r[1])
+    return out
+
+
+def device_busy_fraction(trace_dir: str, window=None):
+    """Fraction of the device-trace span the device spent executing
+    ops — the step timeline's device-side utilization column.
+
+    Busy time is the disjoint union of the leaf events
+    (:func:`device_leaf_events` — no leaf contains another, so the
+    union cannot double-count) on the lowest device pid; the span is
+    ``window`` when given, else first-leaf-start → last-leaf-end.
+    → ``{"busy_s", "span_s", "frac"}`` or ``None`` when the platform
+    records no device track.
+    """
+    leaves, _ = _leaf_and_dropped_events(trace_dir)
+    if not leaves:
+        return None
+    pid0 = min(e.pid for e in leaves)
+    rows = [(e.ts, e.ts + e.dur) for e in leaves if e.pid == pid0]
+    if window is not None:
+        t0, t1 = window
+        rows = [r for r in rows if t0 <= r[0] and r[1] <= t1]
+        span = t1 - t0
+    else:
+        span = (max(r[1] for r in rows) - min(r[0] for r in rows)
+                if rows else 0.0)
+    busy = _union_len(_interval_union(rows))
+    return {
+        "busy_s": busy,
+        "span_s": span,
+        "frac": (busy / span) if span > 0 else None,
+    }
+
+
 def gather_overlap_fraction(trace_dir: str,
                             names: tuple = ("all-gather",),
                             window=None) -> Optional[dict]:
@@ -411,23 +510,13 @@ def gather_overlap_fraction(trace_dir: str,
         low = name.lower()
         return any(s in low for s in names)
 
-    starts, gathers = {}, []
-    # ts-sorted so an async pair's start is always seen before its
-    # done (Chrome-trace event order is not guaranteed).
-    for e in sorted(dev_evs, key=lambda e: e["ts"]):
-        if e["pid"] != pid0 or not is_gather(e.get("name", "")):
-            continue
-        name = e["name"]
-        t0, t1 = e["ts"] / 1e6, (e["ts"] + e["dur"]) / 1e6
-        if "start" in name:
-            starts[name.replace("start", "done")] = (t0, t1)
-        elif name in starts:  # bridge start → done into one interval
-            s0, _ = starts.pop(name)
-            gathers.append((s0, t1))
-        else:
-            gathers.append((t0, t1))
-    gathers.extend(starts.values())  # unpaired starts: own span only
-    gathers = [(t0, t1) for t0, t1 in gathers if in_window(t0, t1)]
+    # Async-pair bridging shared with device_collective_intervals —
+    # see _bridged_intervals for the pairing rules.
+    gathers = [
+        (t0, t1)
+        for _n, t0, t1 in _bridged_intervals(dev_evs, pid0, is_gather)
+        if in_window(t0, t1)
+    ]
     leaves, _ = _leaf_and_dropped_events(trace_dir,
                                          loaded=(xs, pid_names))
     compute = [

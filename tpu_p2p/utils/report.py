@@ -102,6 +102,36 @@ class MatrixReporter:
         return s
 
 
+def render_matrix(values, title: str,
+                  stream: Optional[IO] = None) -> MatrixReporter:
+    """Render a complete N×N matrix in one call.
+
+    The streaming per-cell API above serves live sweeps (one flush per
+    measured cell, p2p_matrix.cc:180); consumers that already hold the
+    whole matrix — the obs ledger's trace-join
+    (:mod:`tpu_p2p.obs.ledger`) — render it here in the identical
+    byte format. NaN cells (links the ledger saw no traffic on) print
+    as the reference's ``0.00`` placeholder but stay NaN in
+    ``reporter.values``, so :meth:`MatrixReporter.summary` aggregates
+    only measured links.
+    """
+    n = len(values)
+    rep = MatrixReporter(n, title, stream)
+    rep.header()
+    for src in range(n):
+        rep.row_label(src)
+        for dst in range(n):
+            v = values[src][dst]
+            if src == dst:
+                rep.diagonal(src)
+            elif math.isnan(v):
+                rep._w("%6.02f " % 0.0)  # placeholder; values[] stays NaN
+            else:
+                rep.cell(src, dst, v)
+        rep.end_row()
+    return rep
+
+
 @dataclass
 class CellRecord:
     """One measured cell — the JSONL twin of one ``%6.02f`` print."""
