@@ -77,6 +77,9 @@ HEADLINE_KEYS = (
     "tp_overlap_frac",
     "tp_step_ms_overlap_none",
     "tp_step_ms_overlap_ring",
+    "ep_overlap_frac",
+    "ep_step_ms_overlap_none",
+    "ep_step_ms_overlap_ring",
     "ring_achieved_gbps",
     "ag_achieved_gbps",
     "obs_step_ms_p50",
@@ -664,6 +667,117 @@ def _tp_overlap_metrics(timing):
     if abs(losses["none"] - losses["ring"]) > 0.05 * ref:
         raise RuntimeError(
             f"tp_overlap loss divergence: none={losses['none']} "
+            f"ring={losses['ring']}"
+        )
+    return out
+
+
+# Null shape of _ep_overlap_metrics — failure must produce the same
+# keys (schema stability, mirroring FSDP_NULL / TP_NULL).
+EP_NULL = {
+    "ep_devices": None,
+    "ep_step_ms_overlap_none": None,
+    "ep_step_ms_overlap_ring": None,
+    "ep_overlap_frac": None,
+    "ep_a2a_ms": None,
+    "ep_source": None,
+}
+
+
+def _ep_overlap_metrics(timing):
+    """Ring-decomposed MoE EP reshards (round 9 tentpole): the
+    flagship MoE step under ``ep_overlap="none"`` vs ``"ring"`` on a
+    pure-ep mesh over every visible device, plus the device-trace
+    overlap fraction — the share of EP-transport time (all-to-all in
+    "none", collective-permute ring hops in "ring") hidden under
+    concurrent compute (:func:`tpu_p2p.utils.profiling.
+    ep_overlap_fraction`).
+
+    On a single chip ep=1, the ring degrades to the byte-identical
+    one-shot-a2a path — equal step times are the pass criterion there,
+    and ``ep_overlap_frac`` is null (no reshard exists to hide). On a
+    multi-device mesh the two step times are the before/after for the
+    decomposition and the fraction should be > 0 on hardware with a
+    device track.
+    """
+    import functools
+    import math
+    import tempfile
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.utils.profiling import ep_overlap_fraction
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.asarray(devs).reshape(n), ("ep",))
+    out = dict(EP_NULL)
+    out["ep_devices"] = n
+    losses = {}
+    for mode in ("none", "ring"):
+        cfg = F.FlagshipConfig(
+            # experts scale with the mesh so the EP shard always
+            # divides (2 local experts per rank); the batch shards
+            # over ep (the standard EP layout — tokens data-parallel
+            # over the expert axis), so the a2a payload per device
+            # stays fixed as n grows, like a real EP config's.
+            batch=2 * n, seq=128, heads=4, head_dim=32, stages=2,
+            microbatches=1, num_experts=2 * n, capacity_factor=2.0,
+            dtype="float32", ep_overlap=mode,
+        )
+        params = F.place_flagship_params(
+            F.init_flagship_params(cfg), mesh, cfg
+        )
+        x, t = F.flagship_example_batch(cfg, mesh)
+        step = F.make_flagship_train_step(mesh, cfg, lr=1e-2)
+        losses[mode] = float(step(params, x, t)[1])
+        if not math.isfinite(losses[mode]):
+            raise RuntimeError(f"ep_overlap={mode} loss non-finite")
+
+        @functools.lru_cache(maxsize=None)
+        def make_chain(k, step=step, x=x, t=t):
+            @jax.jit
+            def f(p):
+                def body(p, _):
+                    p2, loss = step(p, x, t)
+                    return p2, loss
+
+                return jax.lax.scan(body, p, None, length=k)[1]
+
+            return f
+
+        m = _measure(timing, make_chain, params, 8, repeats=2)
+        if m.per_op_s is None:
+            raise RuntimeError(
+                f"ep_overlap={mode} slope was not positive"
+            )
+        out[f"ep_step_ms_overlap_{mode}"] = round(m.per_op_s * 1e3, 3)
+        out["ep_source"] = m.source
+        if mode == "ring":
+            # One traced step for the overlap fraction (null on
+            # platforms recording no device track).
+            with tempfile.TemporaryDirectory(prefix="ep_ov_") as td:
+                with jax.profiler.trace(td):
+                    jax.block_until_ready(step(params, x, t))
+                ov = ep_overlap_fraction(td)
+            if ov is not None:
+                out["ep_overlap_frac"] = (
+                    round(ov["frac"], 4) if ov["frac"] is not None
+                    else None
+                )
+                out["ep_a2a_ms"] = round(ov["gather_s"] * 1e3, 4)
+    # Numerical honesty, as for the FSDP/tp pairs: the two schedules
+    # compute the same per-token math (the ring's chunking crosses no
+    # sum); a real divergence means the ring path is broken and its
+    # step time must not publish (parity is pinned structurally in
+    # tests/test_ep_overlap.py).
+    ref = abs(losses["none"]) or 1.0
+    if abs(losses["none"] - losses["ring"]) > 0.05 * ref:
+        raise RuntimeError(
+            f"ep_overlap loss divergence: none={losses['none']} "
             f"ring={losses['ring']}"
         )
     return out
@@ -1539,6 +1653,14 @@ def main() -> int:
         print(f"# tp overlap measurement failed: {e!r}", file=sys.stderr)
         tp_m = {}
     result["detail"].update({k: tp_m.get(k) for k in TP_NULL})
+    # Ring-decomposed MoE EP reshard metrics (round-9 tentpole), same
+    # both-branch + degrade-to-baseline contract on a pure-ep mesh.
+    try:
+        ep_m = _ep_overlap_metrics(timing)
+    except Exception as e:  # noqa: BLE001 — same rationale
+        print(f"# ep overlap measurement failed: {e!r}", file=sys.stderr)
+        ep_m = {}
+    result["detail"].update({k: ep_m.get(k) for k in EP_NULL})
     # Observability metrics (round-8 tentpole): ledger-joined achieved
     # collective bandwidth + timeline step cadence, both branches.
     try:

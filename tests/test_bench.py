@@ -726,6 +726,33 @@ def test_tp_overlap_metrics_cpu_mesh(monkeypatch):
     assert set(out) == set(bench.TP_NULL)
 
 
+@pytest.mark.slow  # tier-1 budget (round 9): two full ep=8 flagship
+# MoE step compiles; the ring path's tier-1 compile coverage rides
+# tests/test_ep_overlap.py::test_ring_step_matches_a2a_ep4 and the
+# schema/null wiring is pinned by EP_NULL's use in bench main().
+def test_ep_overlap_metrics_cpu_mesh(monkeypatch):
+    # The ep twin of test_tp_overlap_metrics_cpu_mesh: both modes
+    # build + run a real ep=8 flagship MoE step (the ring reshard's
+    # compile coverage on the full visible mesh), the losses agree,
+    # and the schema comes back filled. CPU records no device track,
+    # so the overlap fraction is an explicit null with the step times
+    # present.
+    from tpu_p2p.utils import timing
+
+    monkeypatch.setattr(
+        bench, "_measure",
+        lambda t, mc, x, iters, repeats=3, runs=2:
+            _fake_headline(host=2e-3),
+    )
+    out = bench._ep_overlap_metrics(timing)
+    assert out["ep_devices"] == 8
+    assert out["ep_step_ms_overlap_none"] == pytest.approx(2.0)
+    assert out["ep_step_ms_overlap_ring"] == pytest.approx(2.0)
+    assert out["ep_source"] == "host_differential"
+    assert out["ep_overlap_frac"] is None  # CPU: no device track
+    assert set(out) == set(bench.EP_NULL)
+
+
 def test_compact_line_fits_with_every_headline_key_at_realistic_width():
     # Satellite contract (round 7): the ≤1 KiB budget must hold with
     # ALL headline keys present at realistic numeric widths — i.e. the
@@ -750,6 +777,9 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         "tp_overlap_frac": 0.7654,
         "tp_step_ms_overlap_none": 123.456,
         "tp_step_ms_overlap_ring": 98.765,
+        "ep_overlap_frac": 0.6543,
+        "ep_step_ms_overlap_none": 123.456,
+        "ep_step_ms_overlap_ring": 98.765,
         "ring_achieved_gbps": 1234.56,
         "ag_achieved_gbps": 987.65,
         "obs_step_ms_p50": 123.456,

@@ -45,6 +45,15 @@ SUMMARY_PATTERNS = {
     "flagship_tp_ring": ["--cpu-mesh", "8", "--pattern",
                          "flagship_step", "--tp-overlap", "ring",
                          "--iters", "2"],
+    # The round-9 ep_overlap knob end to end: the flagship_step line
+    # must carry the active mode (build_mesh lands ep=1 on 8 devices,
+    # where ring degrades to the one-shot-a2a path by contract — the
+    # pin is the knob's plumbing + output contract, not an ep>1
+    # measurement, which tests/test_ep_overlap.py covers on explicit
+    # ep meshes).
+    "flagship_ep_ring": ["--cpu-mesh", "8", "--pattern",
+                         "flagship_step", "--ep-overlap", "ring",
+                         "--iters", "2"],
     # The round-8 obs subcommand end to end: live collective-ledger
     # capture (deterministic issue/byte totals on the 8-dev CPU mesh,
     # where no device track exists and the report says so) plus the

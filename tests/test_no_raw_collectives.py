@@ -1,0 +1,85 @@
+"""Static lint: model/ops code must not issue raw jax.lax collectives.
+
+Round 8's collective ledger records every issue made through the
+``tpu_p2p.parallel.collectives`` wrappers (and ``parallel/fsdp.py``,
+which is instrumented in place); a raw ``jax.lax.all_to_all`` in model
+code — exactly what ``models/moe.py`` carried until round 9 — moves
+real bytes that ``join_trace`` then surfaces only as *unmatched*
+device events, so the obs report under-prices the training step and
+nobody notices. This grep-based lint makes that class of regression a
+test failure: every collective issued from ``tpu_p2p/models`` and
+``tpu_p2p/ops`` must go through the ledger-recorded wrappers
+(``collectives.psum`` / ``.ppermute`` / ``.all_to_all``, the ring
+collective-matmul primitives, ``bucketed_all_gather``, or a
+``CollectiveCache`` program). The wrappers themselves live in
+``parallel/collectives.py`` (plus the instrumented ``parallel/
+fsdp.py``), which is the entire allowlist — it is outside the scanned
+trees, so the allowlist is implicit.
+
+Docstrings and comments may (and do) NAME the raw primitives when
+describing baselines; only call sites are flagged, which is why the
+pattern requires the full dotted call ``jax.lax.<collective>(``.
+"""
+
+import os
+import re
+
+PKG = os.path.join(os.path.dirname(__file__), os.pardir, "tpu_p2p")
+
+# Every jax.lax collective that moves bytes across the mesh (pcast /
+# axis_index / axis_size are type/index ops, not transport).
+_RAW_CALL = re.compile(
+    r"jax\.lax\.(psum|psum_scatter|ppermute|all_gather|all_to_all)\s*\("
+)
+
+# The trees the ledger cannot see into unless they use the wrappers.
+SCANNED = ("models", "ops")
+
+
+def _py_files():
+    for sub in SCANNED:
+        root = os.path.join(PKG, sub)
+        for dirpath, _dirs, files in os.walk(root):
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+
+
+def test_model_and_ops_issue_collectives_only_through_wrappers():
+    offenders = []
+    for path in _py_files():
+        with open(path) as fh:
+            for lineno, line in enumerate(fh, 1):
+                m = _RAW_CALL.search(line)
+                if m:
+                    rel = os.path.relpath(path, os.path.dirname(PKG))
+                    offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "raw jax.lax collective calls in model/ops code bypass the "
+        "round-8 collective ledger (obs join_trace would see their "
+        "device events as unmatched). Route them through the "
+        "ledger-recorded wrappers in tpu_p2p/parallel/collectives.py "
+        "(psum / ppermute / all_to_all, the ring collective-matmul "
+        "primitives, or a CollectiveCache program):\n  "
+        + "\n  ".join(offenders)
+    )
+
+
+def test_lint_pattern_catches_a_call_and_ignores_prose():
+    # The lint's own regression guard: the pattern must flag a real
+    # call site and must NOT flag a docstring mention — otherwise a
+    # refactor of the regex could quietly turn the lint into a no-op
+    # (or a comment-matcher that forbids documenting baselines).
+    assert _RAW_CALL.search("y = jax.lax.psum(y, tp)")
+    assert _RAW_CALL.search("slots = jax.lax.all_to_all (slots, ep)")
+    assert not _RAW_CALL.search("# the blocking ``jax.lax.psum`` baseline")
+    assert not _RAW_CALL.search("two ``jax.lax.all_to_all``s serialize")
+
+
+def test_lint_scans_the_expected_trees():
+    # If the package layout moves, the lint must fail loudly rather
+    # than silently scanning nothing.
+    files = list(_py_files())
+    names = {os.path.basename(p) for p in files}
+    assert "moe.py" in names and "attention.py" in names, sorted(names)
+    assert len(files) >= 15, files

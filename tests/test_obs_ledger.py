@@ -306,7 +306,15 @@ def test_per_axis_aggregation(tmp_path):
 def test_live_capture_on_cpu_mesh_records_but_no_track(rt):
     led, join = L.live_capture(rt.mesh, msg_bytes=256 * 1024, count=4)
     kinds = {it.kind for it in led.issues}
-    assert kinds == {"ppermute", "all_gather"}
+    # Round 9: the capture also runs the ep-sharded MoE layer in both
+    # ep_overlap modes, so the EP transport is priced — all_to_all
+    # rows (mode "none") and ep-axis ppermute hops (mode "ring").
+    assert kinds == {"ppermute", "all_gather", "all_to_all"}
+    totals = led.totals()
+    assert totals[("all_to_all", "ep")]["issues"] == 2  # dispatch+combine
+    assert totals[("all_to_all", "ep")]["wire_bytes"] > 0
+    n = rt.mesh.devices.size
+    assert totals[("ppermute", "ep")]["issues"] == 2 * (n - 1)
     assert join.no_device_track  # CPU records host events only
     s = io.StringIO()
     L.print_report(led, join, n=8, stream=s)
@@ -314,6 +322,7 @@ def test_live_capture_on_cpu_mesh_records_but_no_track(rt):
     assert "# collective ledger" in out
     assert "no device track" in out
     assert "ppermute" in out and "all_gather" in out
+    assert "all_to_all" in out
 
 
 def test_print_report_renders_matrix_with_track(tmp_path):

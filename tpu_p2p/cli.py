@@ -105,6 +105,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "= ppermute collective-matmul decomposition, "
                         "per-chunk transfers overlapped with the matmuls;"
                         " no-op at tp=1)")
+    p.add_argument("--ep-overlap", choices=("none", "ring"),
+                   default="none",
+                   help="flagship_step: MoE expert-parallel reshard "
+                        "schedule (ring = shift-by-s ppermute "
+                        "decomposition of the dispatch/combine "
+                        "all_to_alls, expert FFN einsums overlapped "
+                        "with the hops; no-op at ep=1)")
     p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
                    help="testing: force CPU platform with N simulated devices")
     p.add_argument("--list-devices", action="store_true",
@@ -144,6 +151,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         zero_dp=args.zero_dp,
         overlap=args.overlap,
         tp_overlap=args.tp_overlap,
+        ep_overlap=args.ep_overlap,
     )
 
 

@@ -135,6 +135,13 @@ class BenchConfig:
     # tpu_p2p/parallel/collectives.py ring_allgather_matmul /
     # matmul_ring_reducescatter. No-op at tp=1; other patterns
     # ignore it.
+    ep_overlap: str = "none"  # flagship_step: MoE expert-parallel
+    # reshard scheduling ("none" = blocking tiled all_to_alls for
+    # dispatch/combine, "ring" = shift-by-s ppermute decomposition
+    # with the expert FFN einsums overlapping the hops); mirrors
+    # FlagshipConfig.ep_overlap, see tpu_p2p/parallel/collectives.py
+    # ring_all_to_all_matmul / matmul_ring_all_to_all. No-op at ep=1;
+    # other patterns ignore it.
 
     def __post_init__(self) -> None:
         if self.pattern not in PATTERNS:
@@ -159,6 +166,11 @@ class BenchConfig:
         if self.tp_overlap not in ("none", "ring"):
             raise ValueError(
                 f"unknown tp_overlap {self.tp_overlap!r}; expected "
+                "'none' or 'ring'"
+            )
+        if self.ep_overlap not in ("none", "ring"):
+            raise ValueError(
+                f"unknown ep_overlap {self.ep_overlap!r}; expected "
                 "'none' or 'ring'"
             )
 

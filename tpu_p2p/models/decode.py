@@ -44,6 +44,7 @@ from tpu_p2p.models.flagship import (
 )
 from tpu_p2p.models.moe import moe_layer_local
 from tpu_p2p.ops.attention import NEG_INF
+from tpu_p2p.parallel import collectives as C
 
 Cache = Dict[str, jax.Array]
 
@@ -217,7 +218,7 @@ def _decode_sub_block(sub, x, h, k_cache, v_cache, pos, cfg, tp, ep):
     a = a.reshape(b, hq, 1, cfg.head_dim)
     y = jnp.einsum("bhtd,hdm->btm", a, sub["wo"])
     if tp is not None:
-        y = jax.lax.psum(y, tp)
+        y = C.psum(y, tp, label="megatron_attn_join")
     x = x + y
     h2 = _rms_norm(x, sub["ln2"]) if cfg.norm else x
     if cfg.dense_ffn:

@@ -24,6 +24,7 @@ from tpu_p2p.models.flagship_params import (
     flagship_param_specs,
 )
 from tpu_p2p.models.flagship_steps import _sgd_update
+from tpu_p2p.parallel import collectives as C
 
 
 def place_flagship_params_pipelined(params: Params, mesh: Mesh,
@@ -183,7 +184,7 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
             chunk_rows=s_chunk, vma_axes=data_axes, dparam_vma=dparam_vma,
         )
         if data_axes:
-            loss_sum = jax.lax.psum(loss_sum, data_axes)
+            loss_sum = C.psum(loss_sum, data_axes, label="loss_allreduce")
         return _sgd_update(params, grads, lr, n_out), loss_sum / n_out
 
     sm = jax.shard_map(

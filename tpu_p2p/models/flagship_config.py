@@ -84,6 +84,23 @@ class FlagshipConfig:
     # than the fused all-reduce); tp=1 degrades to a no-op. Composes
     # with overlap="prefetch" on dp×tp meshes (tests/test_tp_overlap).
     # Schedule + when "none" wins: docs/tp_overlap.md.
+    ep_overlap: str = "none"  # MoE expert-parallel reshard scheduling
+    # (only meaningful with an ep axis > 1 and the MoE FFN —
+    # dense_ffn=True has no ep transport):
+    # "none" — dispatch and combine each cross the mesh in one
+    # blocking tiled all_to_all — byte-identical baseline; the
+    # ICI reshard serializes against the expert FFN einsums. "ring"
+    # — the collective-matmul decomposition applied to the a2a family
+    # (collectives.ring_all_to_all_matmul / matmul_ring_all_to_all):
+    # each reshard unrolls into shift-by-s ppermute hops over expert
+    # chunks, the arriving slab's w1+gelu (dispatch) / the departing
+    # chunk's w2 einsum (combine) overlapping the in-flight hop. Same
+    # bytes as the one-shot a2a and no cross-chunk sums, so loss/grads
+    # agree elementwise (reassociation-free forward); ep=1 degrades
+    # bitwise. Composes with overlap="prefetch" (dp×ep) and
+    # tp_overlap="ring" (tp×ep) — the three knobs schedule disjoint
+    # collective families (all-gather / all-reduce / all-to-all).
+    # Schedule + when "none" wins: docs/ep_overlap.md.
     use_flash: bool = False  # Pallas flash kernel for the attention
     # math, trainable under every sp_strategy: Ulysses sees the full
     # sequence locally (the standalone custom-vjp kernel drops in);
@@ -170,6 +187,14 @@ class FlagshipConfig:
                 f"unknown tp_overlap {self.tp_overlap!r}; expected "
                 "'none' or 'ring'"
             )
+        # Strict like tp_overlap: a typo ("rings", "Ring") would
+        # silently train on the exposed-a2a path while the run's logs
+        # claim the overlapped EP reshard.
+        if self.ep_overlap not in ("none", "ring"):
+            raise ValueError(
+                f"unknown ep_overlap {self.ep_overlap!r}; expected "
+                "'none' or 'ring'"
+            )
         # Strict: a typo'd policy name must fail at config time, not
         # trace deep inside the step builder. hasattr alone is not
         # enough — jax.checkpoint_policies also exposes FACTORIES
@@ -235,6 +260,7 @@ class FlagshipConfig:
             # length before per-group capacity drops, acceptable for
             # this model family — the library default stays 1024.
             group_size=256,
+            ep_overlap=self.ep_overlap,
         )
 
     def tiny(self, mesh: Mesh) -> "FlagshipConfig":

@@ -29,6 +29,7 @@ from tpu_p2p.models.flagship_params import (
 from tpu_p2p.models.moe import moe_layer_local
 from tpu_p2p.models.pipeline import pipeline_apply_local
 from tpu_p2p.ops.attention import dense_attention, ring_attention_local
+from tpu_p2p.parallel import collectives as C
 
 
 def _rms_norm(x, gain, eps: float = 1e-6):
@@ -91,7 +92,8 @@ def _stage_sub_block(sub_params: Params, x, cfg: FlagshipConfig, sp, tp, ep):
         return _tp_ring_join(sub_params, x, a, cfg, tp, ep)
     y = jnp.einsum("bhtd,hdm->btm", a, sub_params["wo"])
     if tp is not None:
-        y = jax.lax.psum(y, tp)  # Megatron join of head shards
+        # Megatron join of head shards (ledger-recorded wrapper).
+        y = C.psum(y, tp, label="megatron_attn_join")
     x = x + y
     h2 = _rms_norm(x, sub_params["ln2"]) if cfg.norm else x
     if cfg.dense_ffn:
@@ -113,7 +115,7 @@ def _dense_ffn(sub_params: Params, h, tp):
     f_out = jnp.einsum("btf,fm->btm", f_h, sub_params["wf2"],
                        preferred_element_type=jnp.float32)
     if tp is not None:
-        f_out = jax.lax.psum(f_out, tp)
+        f_out = C.psum(f_out, tp, label="megatron_ffn_join")
     return f_out.astype(h.dtype)
 
 
@@ -206,7 +208,7 @@ def _tp_ring_join(sub_params: Params, x, a, cfg: FlagshipConfig, tp, ep):
             [jnp.zeros(x.shape, delta_chunk.dtype), delta_chunk])
         buf = jax.lax.dynamic_update_slice_in_dim(buf, delta_chunk,
                                                   idx * ct, 1)
-        return jax.lax.psum(buf, tp)
+        return C.psum(buf, tp, label="tp_ring_combine")
 
     y_shard = matmul_ring_reducescatter(
         lambda c, _s: jnp.einsum("bhtd,hdm->btm", c, sub_params["wo"]),

@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_p2p.parallel import collectives as C
 from tpu_p2p.models.flagship_config import (
     FlagshipConfig,
     _data_axes,
@@ -74,7 +75,7 @@ def make_flagship_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
         # replicas are typed replicated and count once.
         data_axes = _data_axes(axes)
         if data_axes:
-            loss = jax.lax.psum(loss, data_axes)
+            loss = C.psum(loss, data_axes, label="loss_allreduce")
         return grads, loss
 
     sm = jax.shard_map(
@@ -138,7 +139,7 @@ def make_flagship_lm_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
         loss, grads = jax.value_and_grad(local_loss)(params)
         data_axes = _data_axes(axes)
         if data_axes:
-            loss = jax.lax.psum(loss, data_axes)
+            loss = C.psum(loss, data_axes, label="loss_allreduce")
         return grads, loss
 
     tok_spec = _lm_token_spec(mesh)

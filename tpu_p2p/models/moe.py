@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_p2p.parallel import collectives as C
+
 Params = Dict[str, jax.Array]
 
 
@@ -65,6 +67,27 @@ class MoEConfig:
     # shorten the same-expert burst length that starts dropping
     # (capacity is per group), so the library default stays at 1024
     # and speed-tuned callers opt down (FlagshipConfig.moe() → 256).
+    ep_overlap: str = "none"  # EP reshard scheduling (only meaningful
+    # with an ep axis > 1): "none" — the two blocking tiled
+    # ``all_to_all``s of the dispatch/combine reshard (byte-identical
+    # baseline; the a2a serializes against the expert FFN einsums);
+    # "ring" — the collective-matmul decomposition
+    # (collectives.ring_all_to_all_matmul / matmul_ring_all_to_all):
+    # each a2a unrolls into shift-by-s ppermute hops over expert
+    # chunks, the arriving slab's FFN einsum issuing while the next
+    # hop is in flight (dispatch hides under w1+gelu, combine under
+    # w2). Same bytes, same per-token math (no cross-chunk sums), so
+    # parity is elementwise; ep=1 degrades bitwise. docs/ep_overlap.md.
+
+    def __post_init__(self) -> None:
+        # Strict, like FlagshipConfig's knob checks: a typo ("rings",
+        # "Ring") would silently run the exposed-a2a path while the
+        # run's logs claim overlap.
+        if self.ep_overlap not in ("none", "ring"):
+            raise ValueError(
+                f"unknown ep_overlap {self.ep_overlap!r}; expected "
+                "'none' or 'ring'"
+            )
 
     def capacity(self, tokens: int) -> int:
         """Per-expert slot count for ``tokens`` routed tokens (each
@@ -130,6 +153,15 @@ def _route_topk(x, router_w, num_experts: int, capacity: int, k: int = 1,
         d_r = keep[..., None] * slot                         # [G,E,C]
         dispatch = dispatch + d_r
         combine = combine + d_r * gates[:, r, None, None]
+        # ``used`` advances on EVERY attempt, dropped ones included —
+        # deliberately safe: within a rank, slots fill consecutively
+        # from ``used``, so a drop can only happen once the expert is
+        # already full at that point (used > filled ⟹ filled ==
+        # capacity, by induction over ranks). No later choice rank can
+        # therefore be denied a slot that is actually free — the
+        # GShard priority semantics (earlier choice ranks win, token
+        # order within a rank) hold exactly; pinned against a dense
+        # slot-walking oracle in tests/test_moe.py.
         used = used + jnp.sum(onehot, axis=0)
     return dispatch, combine
 
@@ -140,8 +172,12 @@ def moe_layer_local(params: Params, x, cfg: MoEConfig, ep_axis=None):
     ``x``: local tokens ``[G, D]``. With ``ep_axis`` set, each device
     holds ``E/n`` experts' weights (``params["w1"]/["w2"]`` leading dim
     ep-sharded; the router is replicated) and dispatch crosses the mesh
-    via two ``all_to_all``\\ s. With ``ep_axis=None`` all experts are
-    local and the all_to_alls vanish — the single-device oracle.
+    via two ``all_to_all``\\ s — blocking one-shots under
+    ``cfg.ep_overlap == "none"``, or the overlapped ppermute-ring
+    decomposition under ``"ring"`` (each expert slab's FFN einsum
+    hides the next hop; same bytes, elementwise-identical math). With
+    ``ep_axis=None`` all experts are local and the all_to_alls vanish
+    — the single-device oracle, bitwise regardless of ``ep_overlap``.
     """
     n = jax.lax.axis_size(ep_axis) if ep_axis is not None else 1
     g, d = x.shape
@@ -172,18 +208,42 @@ def moe_layer_local(params: Params, x, cfg: MoEConfig, ep_axis=None):
     slots = jnp.einsum("Ngec,Ngd->eNcd", dispatch.astype(x.dtype), xg,
                        preferred_element_type=jnp.float32).astype(x.dtype)
     slots = slots.reshape(e, ng * cap, d)
-    if ep_axis is not None and n > 1:
+
+    def _ffn1(slab):
+        return jax.nn.gelu(jnp.einsum("ecd,edf->ecf", slab, params["w1"],
+                                      preferred_element_type=jnp.float32))
+
+    def _ffn2(slab):
+        return jnp.einsum("ecf,efd->ecd", slab.astype(x.dtype),
+                          params["w2"],
+                          preferred_element_type=jnp.float32
+                          ).astype(x.dtype)
+
+    if ep_axis is not None and n > 1 and cfg.ep_overlap == "ring":
+        # Latency-hiding EP reshards (docs/ep_overlap.md): both
+        # all_to_alls unroll into shift-by-s ppermute hops over expert
+        # chunks. Dispatch: each arriving [E/n, NC, D] slab's w1+gelu
+        # issues while the next hop is in flight; combine: each
+        # destination chunk's w2 einsum runs while the previous
+        # chunk's transfer flies home. The FFN is batched over
+        # (expert, slot) — no sum crosses a chunk boundary — so the
+        # math per token is the baseline's exactly.
+        h = C.ring_all_to_all_matmul(lambda slab, _src: _ffn1(slab),
+                                     slots, ep_axis,
+                                     split_dim=0, concat_dim=1)
+        y = C.matmul_ring_all_to_all(lambda slab, _dst: _ffn2(slab),
+                                     h, ep_axis,
+                                     split_dim=1, concat_dim=0)
+    elif ep_axis is not None and n > 1:
         # Ship each expert's slots to its owner: [E,NC,D] → [E/n, n·NC, D].
-        slots = jax.lax.all_to_all(slots, ep_axis, split_axis=0,
-                                   concat_axis=1, tiled=True)
-    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", slots, params["w1"],
-                               preferred_element_type=jnp.float32))
-    y = jnp.einsum("ecf,efd->ecd", h.astype(x.dtype), params["w2"],
-                   preferred_element_type=jnp.float32).astype(x.dtype)
-    if ep_axis is not None and n > 1:
+        slots = C.all_to_all(slots, ep_axis, split_axis=0,
+                             concat_axis=1, label="moe_dispatch")
+        y = _ffn2(_ffn1(slots))
         # Inverse reshard: [E/n, n·NC, D] → [E, NC, D] back at the source.
-        y = jax.lax.all_to_all(y, ep_axis, split_axis=1, concat_axis=0,
-                               tiled=True)
+        y = C.all_to_all(y, ep_axis, split_axis=1, concat_axis=0,
+                         label="moe_combine")
+    else:
+        y = _ffn2(_ffn1(slots))
     y = y.reshape(e, ng, cap, d)
     # Scatter expert outputs back to token positions, gate-weighted.
     out = jnp.einsum("Ngec,eNcd->Ngd", combine.astype(y.dtype), y,

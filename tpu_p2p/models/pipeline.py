@@ -42,6 +42,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_p2p.parallel import collectives as C
+
 Params = Dict[str, jax.Array]
 
 
@@ -118,7 +120,8 @@ def pipeline_apply_local(block_fn: Callable, params_local: Params, x_mb,
         x_in = jnp.where(my == 0, feed, prev_in)
         y = block_fn(params_local, x_in)
         # Ship to the next stage (last stage's send has no edge).
-        y_next = jax.lax.ppermute(y, axis, edges) if s_count > 1 else zero
+        y_next = (C.ppermute(y, axis, edges, label="pp_stage_ship")
+                  if s_count > 1 else zero)
         # Last stage: record microbatch t - (S-1) once it's real.
         out_t = t - (s_count - 1)
         upd = jax.lax.dynamic_update_index_in_dim(
@@ -132,7 +135,7 @@ def pipeline_apply_local(block_fn: Callable, params_local: Params, x_mb,
         tick, (zero, outputs0), jnp.arange(m + s_count - 1)
     )
     # Replicate the last stage's outputs to every pp rank.
-    return jax.lax.psum(outputs, axis)
+    return C.psum(outputs, axis, label="pp_output_replicate")
 
 
 def _to_microbatches(x, m: int):

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_p2p.parallel import collectives as C
 from tpu_p2p.models.pipeline import (
     PipelineConfig,
     _to_microbatches,
@@ -406,9 +407,9 @@ def interleaved_grads_local(block_fn: Callable, loss_grad_fn: Callable,
         y_f = block_fn(chunk_of(params_local, f_cidx), x_in)
         y_f = jnp.where(f_on, y_f, zero_mb)
 
-        y_next = (jax.lax.ppermute(y_f, axis, fwd_edges)
+        y_next = (C.ppermute(y_f, axis, fwd_edges, label="pp_fwd_ship")
                   if n > 1 else y_f)
-        g_next = (jax.lax.ppermute(dx, axis, bwd_edges)
+        g_next = (C.ppermute(dx, axis, bwd_edges, label="pp_bwd_ship")
                   if n > 1 else dx)
         return (x_stash, g_stash, y_next, g_next, dparams, loss_acc), None
 
@@ -418,7 +419,7 @@ def interleaved_grads_local(block_fn: Callable, loss_grad_fn: Callable,
     (_, _, _, _, dparams, loss_acc), _ = jax.lax.scan(
         tick, carry0, _sched_tables(sched)
     )
-    return jax.lax.psum(loss_acc, axis), dparams
+    return C.psum(loss_acc, axis, label="pp_loss_replicate"), dparams
 
 
 def make_interleaved_train_step(mesh: Mesh, cfg: PipelineConfig,

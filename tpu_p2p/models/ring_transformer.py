@@ -43,6 +43,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_p2p.ops.attention import dense_attention, ring_attention_local
+from tpu_p2p.parallel import collectives as C
 
 Params = Dict[str, jax.Array]
 
@@ -132,7 +133,8 @@ def _forward(params, x, cfg: ModelConfig, sp, tp, allow_flash=True):
         a = dense_attention(q, k, v, causal=cfg.causal)
     y = jnp.einsum("bhtd,hdm->btm", a, params["wo"])
     if tp is not None:
-        y = jax.lax.psum(y, tp)  # Megatron join of head shards
+        # Megatron join of head shards (ledger-recorded wrapper).
+        y = C.psum(y, tp, label="megatron_attn_join")
     h = jax.nn.gelu(jnp.einsum("btm,mf->btf", x + y, params["w1"]))
     return x + y + jnp.einsum("btf,fm->btm", h, params["w2"])
 
@@ -180,7 +182,7 @@ def make_train_step(mesh: Mesh, cfg: ModelConfig, lr: float = 1e-3):
         # against a single-device oracle in tests/test_model.py.
         dpsp = tuple(a for a in (dp, sp) if a is not None)
         if dpsp:
-            loss = jax.lax.psum(loss, dpsp)
+            loss = C.psum(loss, dpsp, label="loss_allreduce")
         new_params = jax.tree.map(
             lambda p, g: (p.astype(jnp.float32) - lr * g / n_out).astype(p.dtype),
             params, grads,

@@ -374,7 +374,13 @@ def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
     ring (every directed nearest-neighbor link, one compiled program)
     and a slice-own-chunk all-gather chain, both ``count`` hops, give
     the per-link matrix and the per-axis gather bandwidth from ONE
-    capture. → ``(ledger, TraceJoin)``; on a 1-device mesh (no link
+    capture — plus a tiny ep-sharded MoE layer run under BOTH
+    ``ep_overlap`` modes, so the report prices the framework's real
+    expert-parallel transport: the dispatch/combine ``all_to_all``
+    rows (mode ``"none"``) and the ring decomposition's per-hop
+    ``ppermute`` rows on the ``ep`` axis (mode ``"ring"``) — the
+    round-9 coverage the raw-a2a MoE used to leak past the ledger.
+    → ``(ledger, TraceJoin)``; on a 1-device mesh (no link
     exists) the ledger is empty and the join is empty too — but NOT
     marked ``no_device_track``: that flag means the platform records
     host events only, which would be a false diagnosis on a 1-chip
@@ -383,7 +389,10 @@ def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
     import tempfile
 
     import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh as _Mesh
 
+    from tpu_p2p.models import moe as M
     from tpu_p2p.parallel import collectives as C
 
     axis = mesh.axis_names[0]
@@ -393,6 +402,18 @@ def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
         return led, TraceJoin()
     cache = C.CollectiveCache()
     payload = C.make_payload(mesh, msg_bytes)
+    # The MoE EP pricing workload: one expert per rank, capacity-free,
+    # fixed tiny shapes — deterministic issue/byte totals for the
+    # report regardless of msg_bytes.
+    ep_mesh = _Mesh(np.asarray(mesh.devices).reshape(-1), ("ep",))
+    moe_x = jnp.zeros((8 * n, 16), jnp.float32)
+    moe_layers = []
+    for mode in ("none", "ring"):
+        cfg = M.MoEConfig(d_model=16, d_ff=32, num_experts=n,
+                          capacity_factor=float(n), ep_overlap=mode)
+        moe_layers.append(
+            (M.make_moe_layer(ep_mesh, cfg), M.init_moe_params(cfg))
+        )
     with recording(led):
         ring = cache.permute_chain(mesh, axis, C.ring_edges(n), count)
         ag = cache.ag_chain(mesh, axis, count)
@@ -400,10 +421,14 @@ def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
         # compile time must not land inside the capture.
         jax.block_until_ready(ring(payload))
         jax.block_until_ready(ag(payload))
+        for layer, params in moe_layers:
+            jax.block_until_ready(layer(params, moe_x))
     with tempfile.TemporaryDirectory(prefix="obs_cap_") as td:
         with jax.profiler.trace(td):
             jax.block_until_ready(ring(payload))
             jax.block_until_ready(ag(payload))
+            for layer, params in moe_layers:
+                jax.block_until_ready(layer(params, moe_x))
         join = join_trace(led, td)
     return led, join
 

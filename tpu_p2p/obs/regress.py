@@ -74,6 +74,8 @@ TOLERANCES: Dict[str, Tolerance] = {
     "fsdp_step_ms_overlap_prefetch": Tolerance("lower", 0.25),
     "tp_overlap_frac": Tolerance("higher", 0.25),
     "tp_step_ms_overlap_ring": Tolerance("lower", 0.25),
+    "ep_overlap_frac": Tolerance("higher", 0.25),
+    "ep_step_ms_overlap_ring": Tolerance("lower", 0.25),
     # PR 3 obs keys (bench.py _obs_metrics).
     "ring_achieved_gbps": Tolerance("higher", 0.25),
     "ag_achieved_gbps": Tolerance("higher", 0.25),

@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_p2p.parallel import collectives as C
+
 NEG_INF = -1e30  # large-negative instead of -inf: keeps XLA happy on
 # fully-masked rows (no NaN from (-inf) - (-inf))
 
@@ -262,8 +264,8 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
         # the permute output is not consumed by this body's compute, so
         # XLA's async collective-permute overlaps transfer with math
         # (same structure as tpu_p2p.ops.ring_flash).
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, edges)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, edges)
+        k_nxt = C.ppermute(k_cur, axis_name, edges, label="ring_kv_rotate")
+        v_nxt = C.ppermute(v_cur, axis_name, edges, label="ring_kv_rotate")
         src = jax.lax.rem(my - i + n + n, n)  # block currently held
         o2, m2, l2 = accumulate(o, m, l, k_cur, v_cur, src)
         return (o2, m2, l2, k_nxt, v_nxt), None

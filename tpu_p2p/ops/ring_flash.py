@@ -50,6 +50,7 @@ from tpu_p2p.ops.attention import (
     live_ring_hops as _live_hops,
     zigzag_chunks,
 )
+from tpu_p2p.parallel import collectives as C
 from tpu_p2p.parallel.collectives import ring_edges as _ring_edges
 
 
@@ -127,8 +128,8 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, layout, window):
         # the permute's output is not consumed by this body's compute,
         # so XLA's async collective-permute overlaps the hop transfer
         # with the kernel (a permute→compute chain would serialize).
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, edges)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, edges)
+        k_nxt = C.ppermute(k_cur, axis_name, edges, label="ring_kv_rotate")
+        v_nxt = C.ppermute(v_cur, axis_name, edges, label="ring_kv_rotate")
         src = jax.lax.rem(my - i + n + n, n)
         o2, m2, l2 = _accumulate(q, k_cur, v_cur, o, m, l, my, src,
                                  n, causal, layout, window)
@@ -207,14 +208,14 @@ def _ring_flash_bwd(axis_name, causal, layout, window, res, g):
         # a true ordering dependency on _block_grads (the accumulator
         # travels WITH its KV block; after a full rotation both are
         # back at the owner), so only those permutes stay behind it.
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, edges)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, edges)
+        k_nxt = C.ppermute(k_cur, axis_name, edges, label="ring_kv_rotate")
+        v_nxt = C.ppermute(v_cur, axis_name, edges, label="ring_kv_rotate")
         src = jax.lax.rem(my - i + n + n, n)
         dq, dka, dva = _block_grads(dq, dka, dva, q, k_cur, v_cur, g, L,
                                     delta, my, src, n, causal, layout,
                                     window)
-        dka = jax.lax.ppermute(dka, axis_name, edges)
-        dva = jax.lax.ppermute(dva, axis_name, edges)
+        dka = C.ppermute(dka, axis_name, edges, label="ring_dkv_rotate")
+        dva = C.ppermute(dva, axis_name, edges, label="ring_dkv_rotate")
         return (dq, k_nxt, v_nxt, dka, dva), None
 
     hops = _live_hops(n, t, causal, layout, window)
@@ -234,13 +235,13 @@ def _ring_flash_bwd(axis_name, causal, layout, window, res, g):
         # (full un-windowed rotation: one forward hop).
         if n - hops <= hops:
             for _ in range(n - hops):
-                dka = jax.lax.ppermute(dka, axis_name, edges)
-                dva = jax.lax.ppermute(dva, axis_name, edges)
+                dka = C.ppermute(dka, axis_name, edges, label="ring_dkv_rotate")
+                dva = C.ppermute(dva, axis_name, edges, label="ring_dkv_rotate")
         else:
             rev = _ring_edges(n, -1)
             for _ in range(hops):
-                dka = jax.lax.ppermute(dka, axis_name, rev)
-                dva = jax.lax.ppermute(dva, axis_name, rev)
+                dka = C.ppermute(dka, axis_name, rev, label="ring_dkv_return")
+                dva = C.ppermute(dva, axis_name, rev, label="ring_dkv_return")
     else:
         dq, dka, dva = _block_grads(dq, dka, dva, q, k, v, g, L, delta,
                                     my, my, n, causal, layout, window)

@@ -37,18 +37,19 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_p2p.ops.attention import _check_window, dense_attention
+from tpu_p2p.parallel import collectives as C
 
 
 def _heads_to_seq(x, axis_name: str):
     """[B, H, T/n, D] → [B, H/n, T, D]: scatter heads, gather sequence."""
-    return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
-                              tiled=True)
+    return C.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                        label="ulysses_heads_to_seq")
 
 
 def _seq_to_heads(x, axis_name: str):
     """[B, H/n, T, D] → [B, H, T/n, D]: the inverse reshard."""
-    return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                              tiled=True)
+    return C.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                        label="ulysses_seq_to_heads")
 
 
 def ulysses_attention_local(q, k, v, axis_name: str, *, causal: bool = False,

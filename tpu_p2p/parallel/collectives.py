@@ -401,6 +401,196 @@ def matmul_ring_reducescatter(compute_chunk: Callable, x, axis: str,
     return acc
 
 
+def _shift_edges(n: int, s: int) -> Tuple[Edge, ...]:
+    """Shift-by-``s`` permutation edges — one hop of the decomposed
+    all-to-all (hop ``s`` carries every rank's chunk for the rank ``s``
+    positions downstream)."""
+    return tuple((j, (j + s) % n) for j in range(n))
+
+
+def ring_all_to_all_matmul(compute_chunk: Callable, x, axis: str,
+                           split_dim: int, concat_dim: int):
+    """Tiled ``all_to_all`` of ``x`` along mesh ``axis`` *through* a
+    matmul: each arriving chunk's ``compute_chunk`` issues while the
+    next hop is still in flight — the a2a member of the decomposition
+    family (`ring_allgather_matmul` / `matmul_ring_reducescatter`).
+
+    The one-shot ``jax.lax.all_to_all(split_axis=split_dim,
+    concat_axis=concat_dim, tiled=True)`` moves ``(n-1)/n`` of the
+    buffer in one blocking collective. This decomposes it into the
+    same bytes as ``n-1`` shift-by-``s`` ``ppermute`` hops
+    (:func:`_shift_edges` — hop ``s`` ships every rank's chunk for the
+    rank ``s`` downstream, so together the hops realize the full
+    exchange), with each hop issued BEFORE the previous arrival's
+    compute so the transfer has no consumer in that step's matmul and
+    XLA's latency-hiding scheduler overlaps the two (the same
+    issue-before-consume ordering as the gather ring).
+
+    ``x``: full along ``split_dim`` (size divisible by the axis size);
+    chunk ``d`` of ``split_dim`` is destined for rank ``d``.
+    ``compute_chunk(chunk, src) → y_chunk`` consumes the chunk that
+    originated at rank ``src`` (a traced index) and must be
+    shape-uniform across chunks; outputs are concatenated along
+    ``concat_dim`` in source-rank order — exactly
+    ``compute(all_to_all(x))`` for any per-source-chunk-independent
+    ``compute`` (the MoE expert FFN: batched over experts and
+    capacity slots, so chunking the capacity dim changes no sum).
+
+    Differentiable: each hop's transpose is the inverse permute (no
+    cross-rank summing — the same gradient structure as the one-shot
+    all_to_all's inverse-reshard transpose), and the slice/update
+    transposes land on disjoint offsets. A 1-sized axis degrades to
+    ``compute_chunk(x, 0)``.
+    """
+    n = jax.lax.axis_size(axis)
+    if n == 1:
+        return compute_chunk(x, 0)
+    if x.shape[split_dim] % n:
+        raise ValueError(
+            f"split dim {split_dim} of size {x.shape[split_dim]} does "
+            f"not divide by axis size {n}"
+        )
+    idx = jax.lax.axis_index(axis)
+    ce = x.shape[split_dim] // n
+    chunk_bytes = _aval_bytes(x) // n
+    # n-1 hops, one ppermute per shift distance — the same total bytes
+    # as the one-shot a2a, (n-1)/n of the buffer per participant.
+    for s in range(1, n):
+        _record_issue("ppermute", axis, nbytes=chunk_bytes, axis_size=n,
+                      edges=_shift_edges(n, s),
+                      label="ring_all_to_all_matmul")
+
+    def send_chunk(s):
+        d = (idx + s) % n  # this rank's chunk destined for rank d
+        return jax.lax.dynamic_slice_in_dim(x, d * ce, ce, split_dim)
+
+    cur, out = send_chunk(0), None
+    for s in range(n):
+        # Issue hop s+1 BEFORE consuming this step's arrival: the
+        # in-flight chunk has no consumer in compute_chunk, so the
+        # transfer rides under the matmul.
+        nxt = (jax.lax.ppermute(send_chunk(s + 1), axis,
+                                _shift_edges(n, s + 1))
+               if s + 1 < n else None)
+        src = (idx - s) % n  # hop s delivers the rank s upstream
+        y = compute_chunk(cur, src)
+        if out is None:
+            c = y.shape[concat_dim]
+            full = list(y.shape)
+            full[concat_dim] = n * c
+            out = jnp.zeros(tuple(full), y.dtype)
+        # Fresh zeros are unvarying under vma-checked shard_map while
+        # y varies over (at least) ``axis`` — promote per update so
+        # the dynamic_update_slice operands always agree.
+        out, y = _promote_vma([out, y])
+        out = jax.lax.dynamic_update_slice_in_dim(out, y, src * c,
+                                                  concat_dim)
+        cur = nxt
+    return out
+
+
+def matmul_ring_all_to_all(compute_chunk: Callable, x, axis: str,
+                           split_dim: int, concat_dim: int):
+    """The mirrored combine direction of
+    :func:`ring_all_to_all_matmul`: per-destination chunks are
+    *computed*, then shipped home over shift-by-``s`` ``ppermute``
+    hops — the overlapped decomposition of
+    ``all_to_all(compute(x))``.
+
+    ``x`` is full along ``split_dim``; chunk ``d`` belongs to rank
+    ``d`` (the MoE combine: capacity segment ``d`` holds rank ``d``'s
+    tokens' expert outputs). ``compute_chunk(chunk, dst) → y_chunk``
+    computes the chunk destined for rank ``dst`` (traced); each
+    computed chunk's ppermute issues immediately and the NEXT chunk's
+    matmul runs while it is in flight (the arrivals' only consumers
+    are the trailing scatter updates, so no transfer blocks compute).
+    Outputs concatenate along ``concat_dim`` in source-rank order —
+    exactly the one-shot a2a's tiled concat. Same byte count, same
+    inverse-permute gradient structure, same 1-sized-axis degrade as
+    the dispatch direction.
+    """
+    n = jax.lax.axis_size(axis)
+    if n == 1:
+        return compute_chunk(x, 0)
+    if x.shape[split_dim] % n:
+        raise ValueError(
+            f"split dim {split_dim} of size {x.shape[split_dim]} does "
+            f"not divide by axis size {n}"
+        )
+    idx = jax.lax.axis_index(axis)
+    ct = x.shape[split_dim] // n
+
+    def part(d):
+        chunk = jax.lax.dynamic_slice_in_dim(x, d * ct, ct, split_dim)
+        return compute_chunk(chunk, d)
+
+    arrivals = []
+    for s in range(1, n):
+        # Compute the chunk for the rank s upstream, ship it with the
+        # reverse shift (so it lands exactly there), then move on to
+        # the next chunk's matmul while the transfer flies.
+        y = part((idx - s) % n)
+        _record_issue("ppermute", axis, nbytes=_aval_bytes(y),
+                      axis_size=n, edges=_shift_edges(n, n - s),
+                      label="matmul_ring_all_to_all")
+        arr = jax.lax.ppermute(y, axis, _shift_edges(n, n - s))
+        arrivals.append((arr, (idx + s) % n))
+    own = part(idx)
+    c = own.shape[concat_dim]
+    full = list(own.shape)
+    full[concat_dim] = n * c
+    out = jnp.zeros(tuple(full), own.dtype)
+    for y, src in [(own, idx)] + arrivals:
+        out, y = _promote_vma([out, y])
+        out = jax.lax.dynamic_update_slice_in_dim(out, y, src * c,
+                                                  concat_dim)
+    return out
+
+
+# -- instrumented one-shot wrappers -----------------------------------
+# Thin passthroughs over the jax.lax collectives for MODEL/OPS code:
+# identical semantics (autodiff, vma typing), plus one trace-time
+# ledger record per issue so tpu_p2p.obs.ledger.join_trace can price
+# the transport. tests/test_no_raw_collectives.py lints that model and
+# ops modules issue collectives only through these (raw jax.lax calls
+# there would silently fall out of the ledger again — the round-9
+# coverage gap this closes). Calls inside scan bodies record once per
+# trace while the device executes `length` times; the ledger join's
+# cyclic matching absorbs that (ledger.py module docstring).
+
+
+def psum(x, axis, *, label: str = "psum"):
+    """Ledger-recorded ``jax.lax.psum`` (``axis`` may be a name or a
+    tuple of names — recorded as one all-reduce over the product
+    size, which is how XLA lowers it)."""
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+    n = 1
+    for a in names:
+        n *= jax.lax.axis_size(a)
+    _record_issue("all_reduce", "+".join(names), nbytes=_aval_bytes(x),
+                  axis_size=n, label=label)
+    return jax.lax.psum(x, axis)
+
+
+def ppermute(x, axis, edges, *, label: str = "ppermute"):
+    """Ledger-recorded ``jax.lax.ppermute``."""
+    _record_issue("ppermute", axis, nbytes=_aval_bytes(x),
+                  axis_size=jax.lax.axis_size(axis),
+                  edges=tuple((int(s), int(d)) for s, d in edges),
+                  label=label)
+    return jax.lax.ppermute(x, axis, edges)
+
+
+def all_to_all(x, axis, split_axis: int, concat_axis: int, *,
+               tiled: bool = True, label: str = "all_to_all"):
+    """Ledger-recorded ``jax.lax.all_to_all`` — the EP/Ulysses
+    transport (BASELINE.json configs[3])."""
+    _record_issue("all_to_all", axis, nbytes=_aval_bytes(x),
+                  axis_size=jax.lax.axis_size(axis), label=label)
+    return jax.lax.all_to_all(x, axis, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=tiled)
+
+
 class CollectiveCache:
     """Compile-once cache of jitted collective programs.
 
@@ -887,6 +1077,57 @@ class CollectiveCache:
                         lambda c, _s: jnp.einsum("tk,kf->tf", c, w),
                         full, axis, chunk_dim=0)
                     return own.astype(carry.dtype).reshape(shape), None
+
+                out, _ = jax.lax.scan(step, x, None, length=count)
+                return out
+
+            return jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+            )
+
+        return self._get(key, build)
+
+    def ep_ring_chain(self, mesh: Mesh, axis: str, count: int,
+                      k: int = 64):
+        """``count`` chained ring all-to-all-matmul round trips — one
+        hop is :func:`ring_all_to_all_matmul` (the dispatch exchange
+        through a ``[k, k]`` matmul, one expert row per rank) followed
+        by :func:`matmul_ring_all_to_all` (per-destination matmuls
+        shipped home). Shape-preserving, so it scans; the benchmark
+        twin of the flagship ``ep_overlap="ring"`` MoE transport,
+        measurable against :meth:`all_to_all` (the same bytes in one
+        blocking collective with the matmuls outside) the way
+        :meth:`tp_ring_chain` measures against :meth:`rs_ag_chain`.
+
+        The payload's trailing dim is viewed as ``[n, elems/(n·k), k]``
+        — experts × capacity slots × features, one expert per rank
+        (``elems % (n·k) == 0`` required); the weight is a fixed
+        identity so values pass through unchanged (pure transport +
+        matmul-launch cost, same note as :meth:`tp_ring_chain`).
+        """
+        key = ("ep_ring_chain", mesh, axis, count, k)
+
+        def build():
+            spec = P(*mesh.axis_names, None)
+            n = mesh.shape[axis]
+
+            def f(x):
+                if x.shape[-1] % (n * k):
+                    raise ValueError(
+                        f"payload {x.shape[-1]} elems not divisible by "
+                        f"experts x features ({n} x {k})")
+                shape = x.shape
+                w = jnp.eye(k, dtype=x.dtype)
+
+                def step(carry, _):
+                    y = carry.reshape(n, -1, k)
+                    h = ring_all_to_all_matmul(
+                        lambda c, _s: jnp.einsum("eck,kf->ecf", c, w),
+                        y, axis, split_dim=0, concat_dim=1)
+                    back = matmul_ring_all_to_all(
+                        lambda c, _d: jnp.einsum("ecf,fk->eck", c, w),
+                        h, axis, split_dim=1, concat_dim=0)
+                    return back.astype(carry.dtype).reshape(shape), None
 
                 out, _ = jax.lax.scan(step, x, None, length=count)
                 return out

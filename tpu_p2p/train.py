@@ -495,6 +495,13 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="Megatron tp-join schedule (ring = ppermute "
                         "collective-matmul decomposition overlapping "
                         "transfers with the matmuls; no-op at tp=1)")
+    p.add_argument("--ep-overlap", default="none",
+                   choices=("none", "ring"),
+                   help="MoE expert-parallel reshard schedule (ring = "
+                        "shift-by-s ppermute decomposition of the "
+                        "dispatch/combine all_to_alls, expert FFN "
+                        "einsums overlapped with the hops; no-op at "
+                        "ep=1)")
     return p
 
 
@@ -524,7 +531,7 @@ def main(argv=None) -> int:
         sp_strategy=args.sp_strategy, use_flash=args.flash,
         norm=args.norm, dense_ffn=args.dense_ffn, rope=args.rope,
         remat=args.remat, zero_dp=args.zero_dp, overlap=args.overlap,
-        tp_overlap=args.tp_overlap,
+        tp_overlap=args.tp_overlap, ep_overlap=args.ep_overlap,
     )
     summary = run_training(
         mesh, cfg, steps=args.steps, lr=args.lr, seed=args.seed,

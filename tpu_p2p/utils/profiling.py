@@ -45,6 +45,7 @@ __all__ = [
     "differential_from_trace",
     "gather_overlap_fraction",
     "tp_overlap_fraction",
+    "ep_overlap_fraction",
     "validate_differential",
     "measure_headline",
 ]
@@ -557,6 +558,30 @@ def tp_overlap_fraction(trace_dir: str, window=None) -> Optional[dict]:
     return gather_overlap_fraction(trace_dir,
                                    names=("collective-permute",),
                                    window=window)
+
+
+def ep_overlap_fraction(trace_dir: str, window=None) -> Optional[dict]:
+    """Fraction of device EP-transport time hidden under concurrent
+    compute — the ``ep_overlap="ring"`` metric (``bench.py``'s
+    ``ep_overlap_frac``), the a2a twin of
+    :func:`gather_overlap_fraction` / :func:`tp_overlap_fraction`.
+
+    Under ``ep_overlap="none"`` the MoE dispatch/combine reshards are
+    ``all-to-all`` device events; under ``"ring"`` the same bytes move
+    as shift-by-s ``collective-permute`` hops
+    (``tpu_p2p/parallel/collectives.py ring_all_to_all_matmul`` /
+    ``matmul_ring_all_to_all``) — this metric counts BOTH event
+    families, so it reads the EP transport's hidden share in either
+    mode from one capture (on the bench's pure-ep mesh no other
+    permute ring runs, so every counted interval is EP transport; on
+    mixed tp×ep meshes use ``tp_overlap_fraction``'s name filter to
+    separate the families). Same return contract as the twins:
+    ``None`` without a device track, ``frac=None`` when no matching
+    collective exists in the capture (ep=1 — nothing to hide).
+    """
+    return gather_overlap_fraction(
+        trace_dir, names=("all-to-all", "collective-permute"),
+        window=window)
 
 
 def differential_from_trace(trace_dir: str, n_short: int, n_long: int,

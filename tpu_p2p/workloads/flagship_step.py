@@ -44,6 +44,11 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
         # joins (tpu_p2p/models/flagship_forward._tp_ring_join);
         # degrades to the psum path on tp=1 meshes.
         mc = dataclasses.replace(mc, tp_overlap=cfg.tp_overlap)
+    if model_cfg is None and cfg.ep_overlap != "none":
+        # --ep-overlap ring: the ppermute-decomposed MoE dispatch/
+        # combine reshards (tpu_p2p/models/moe.py ep_overlap="ring");
+        # degrades to the one-shot a2a path on ep=1 meshes.
+        mc = dataclasses.replace(mc, ep_overlap=cfg.ep_overlap)
     # mc as the placement cfg: with zero_dp the param specs carry the
     # ZeRO dp dim, and placing without it would materialize full
     # replicas (the memory ZeRO exists to avoid) + a first-step
@@ -69,14 +74,17 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
     tok_s = tokens / s.p50 if s.p50 == s.p50 and s.p50 > 0 else float("nan")
     axes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if ctx.is_printer:
-        # tp_overlap rides the line only when active, so earlier
-        # rounds' flagship_step output stays byte-identical.
+        # tp_overlap/ep_overlap ride the line only when active, so
+        # earlier rounds' flagship_step output stays byte-identical.
         tp_part = (f" tp_overlap={mc.tp_overlap}"
                    if mc.tp_overlap != "none" else "")
+        ep_part = (f" ep_overlap={mc.ep_overlap}"
+                   if mc.ep_overlap != "none" else "")
         sys.stdout.write(
             f"flagship_step mesh {axes} {mc.sp_strategy}-SP "
             f"B{mc.batch} T{mc.seq} H{mc.heads} E{mc.num_experts} "
-            f"S{mc.stages}x{mc.microbatches}mb {mc.dtype}{tp_part}: "
+            f"S{mc.stages}x{mc.microbatches}mb {mc.dtype}"
+            f"{tp_part}{ep_part}: "
             f"p50 {s.p50 * 1e3:.2f}ms/step  {tok_s:,.0f} tokens/s\n"
         )
         sys.stdout.flush()
@@ -86,7 +94,7 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
             msg_bytes=0, gbps_val=float("nan"), samples=s,
             mesh=str(axes), sp_strategy=mc.sp_strategy,
             batch=mc.batch, seq=mc.seq, tokens_per_s=tok_s,
-            tp_overlap=mc.tp_overlap,
+            tp_overlap=mc.tp_overlap, ep_overlap=mc.ep_overlap,
         )
     )
     return {"mesh": axes, "p50_ms": s.p50 * 1e3, "tokens_per_s": tok_s}
