@@ -80,6 +80,9 @@ HEADLINE_KEYS = (
     "ep_overlap_frac",
     "ep_step_ms_overlap_none",
     "ep_step_ms_overlap_ring",
+    "pp_overlap_frac",
+    "pp_step_ms_overlap_none",
+    "pp_step_ms_overlap_wave",
     "ring_achieved_gbps",
     "ag_achieved_gbps",
     "obs_step_ms_p50",
@@ -88,8 +91,12 @@ HEADLINE_KEYS = (
     "decode_hbm_ms_per_token",
     "flagship_large_tokens_per_s",
     "pairs_measured",
-    "min_gbps",
-    "max_gbps",
+    # min_gbps/max_gbps retired from the compact line in round 10 (the
+    # pp_* keys took their bytes): they were the designed drop-first
+    # tail — never graded, never gated (obs/regress.py TOLERANCES),
+    # never drift-guard quoted (tests/test_parity_drift.QUOTES), and
+    # the matrix extremes still persist in BENCH_detail.json while the
+    # line's top-level "value" carries the graded pairwise average.
 )
 
 
@@ -779,6 +786,121 @@ def _ep_overlap_metrics(timing):
         raise RuntimeError(
             f"ep_overlap loss divergence: none={losses['none']} "
             f"ring={losses['ring']}"
+        )
+    return out
+
+
+# Null shape of _pp_overlap_metrics — failure must produce the same
+# keys (schema stability, mirroring FSDP_NULL / TP_NULL / EP_NULL).
+PP_NULL = {
+    "pp_devices": None,
+    "pp_step_ms_overlap_none": None,
+    "pp_step_ms_overlap_wave": None,
+    "pp_overlap_frac": None,
+    "pp_permute_ms": None,
+    "pp_source": None,
+}
+
+
+def _pp_overlap_metrics(timing):
+    """Token-chunk wave pipeline stage hops (round 10 tentpole): the
+    flagship GPipe step under ``pp_overlap="none"`` vs ``"wave"`` on a
+    pure-pp mesh over every visible device, plus the device-trace
+    overlap fraction — the share of collective-permute time (the stage
+    transport in either mode) hidden under concurrent compute
+    (:func:`tpu_p2p.utils.profiling.pp_overlap_fraction`).
+
+    On a single chip pp=1, the wave degrades to the byte-identical
+    one-shot-ppermute path — equal step times are the pass criterion
+    there, and ``pp_overlap_frac`` is null (no hop exists to hide). On
+    a multi-device mesh the two step times are the before/after for
+    the decomposition and the fraction should be > 0 on hardware with
+    a device track. This closes the overlap quartet: all four
+    collective families the flagship issues (all-gather / all-reduce /
+    all-to-all / collective-permute) now have a scheduled mode and a
+    measured hidden share.
+    """
+    import functools
+    import math
+    import tempfile
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.utils.profiling import pp_overlap_fraction
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.asarray(devs).reshape(n), ("pp",))
+    out = dict(PP_NULL)
+    out["pp_devices"] = n
+    losses = {}
+    for mode in ("none", "wave"):
+        cfg = F.FlagshipConfig(
+            # stages scale with the mesh (one transformer block per pp
+            # rank); 4 microbatches keep the bubble fraction realistic
+            # and give the wave 4 ships per stage per step. The dense
+            # FFN keeps the step MoE-free — on a pure-pp mesh every
+            # expert would be local anyway, and the permute family
+            # must be the only transport in the capture.
+            batch=4, seq=128, heads=4, head_dim=32, stages=n,
+            microbatches=4, dense_ffn=True, moe_mult=2,
+            dtype="float32", pp_overlap=mode, pp_chunks=4,
+        )
+        params = F.place_flagship_params(
+            F.init_flagship_params(cfg), mesh, cfg
+        )
+        x, t = F.flagship_example_batch(cfg, mesh)
+        step = F.make_flagship_train_step(mesh, cfg, lr=1e-2)
+        losses[mode] = float(step(params, x, t)[1])
+        if not math.isfinite(losses[mode]):
+            raise RuntimeError(f"pp_overlap={mode} loss non-finite")
+
+        @functools.lru_cache(maxsize=None)
+        def make_chain(k, step=step, x=x, t=t):
+            @jax.jit
+            def f(p):
+                def body(p, _):
+                    p2, loss = step(p, x, t)
+                    return p2, loss
+
+                return jax.lax.scan(body, p, None, length=k)[1]
+
+            return f
+
+        m = _measure(timing, make_chain, params, 8, repeats=2)
+        if m.per_op_s is None:
+            raise RuntimeError(
+                f"pp_overlap={mode} slope was not positive"
+            )
+        out[f"pp_step_ms_overlap_{mode}"] = round(m.per_op_s * 1e3, 3)
+        out["pp_source"] = m.source
+        if mode == "wave":
+            # One traced step for the overlap fraction (null on
+            # platforms recording no device track).
+            with tempfile.TemporaryDirectory(prefix="pp_ov_") as td:
+                with jax.profiler.trace(td):
+                    jax.block_until_ready(step(params, x, t))
+                ov = pp_overlap_fraction(td)
+            if ov is not None:
+                out["pp_overlap_frac"] = (
+                    round(ov["frac"], 4) if ov["frac"] is not None
+                    else None
+                )
+                out["pp_permute_ms"] = round(ov["gather_s"] * 1e3, 4)
+    # Numerical honesty, as for the FSDP/tp/ep trios: the wave chunks
+    # the hop without touching any arithmetic (identity chunk compute,
+    # no sum crosses a chunk), so the two schedules are elementwise
+    # equal; a real divergence means the wave path is broken and its
+    # step time must not publish (parity is pinned structurally in
+    # tests/test_pp_overlap.py).
+    ref = abs(losses["none"]) or 1.0
+    if abs(losses["none"] - losses["wave"]) > 0.05 * ref:
+        raise RuntimeError(
+            f"pp_overlap loss divergence: none={losses['none']} "
+            f"wave={losses['wave']}"
         )
     return out
 
@@ -1661,6 +1783,15 @@ def main() -> int:
         print(f"# ep overlap measurement failed: {e!r}", file=sys.stderr)
         ep_m = {}
     result["detail"].update({k: ep_m.get(k) for k in EP_NULL})
+    # Token-chunk wave pipeline stage hops (round-10 tentpole), same
+    # both-branch + degrade-to-baseline contract on a pure-pp mesh —
+    # the last collective family of the overlap quartet.
+    try:
+        pp_m = _pp_overlap_metrics(timing)
+    except Exception as e:  # noqa: BLE001 — same rationale
+        print(f"# pp overlap measurement failed: {e!r}", file=sys.stderr)
+        pp_m = {}
+    result["detail"].update({k: pp_m.get(k) for k in PP_NULL})
     # Observability metrics (round-8 tentpole): ledger-joined achieved
     # collective bandwidth + timeline step cadence, both branches.
     try:

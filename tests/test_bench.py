@@ -300,6 +300,10 @@ def test_main_multichip_branch_schema(capsys, monkeypatch, tmp_path):
         bench, "_obs_metrics",
         lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
     )
+    monkeypatch.setattr(
+        bench, "_pp_overlap_metrics",
+        lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
+    )
     compact, r = _run_main(capsys, monkeypatch, tmp_path)
     assert compact["metric"] == r["metric"]
     assert compact["value"] == r["value"]
@@ -311,6 +315,8 @@ def test_main_multichip_branch_schema(capsys, monkeypatch, tmp_path):
     assert r["detail"]["fsdp_step_ms_overlap_prefetch"] is None
     assert r["detail"]["tp_overlap_frac"] is None
     assert r["detail"]["tp_step_ms_overlap_ring"] is None
+    assert r["detail"]["pp_overlap_frac"] is None
+    assert r["detail"]["pp_step_ms_overlap_wave"] is None
     assert r["detail"]["ring_achieved_gbps"] is None
     assert r["detail"]["obs_step_ms_p50"] is None
     assert r["unit"] == "Gbps"
@@ -376,6 +382,8 @@ def test_main_multichip_bad_env_falls_back(capsys, monkeypatch, tmp_path):
     )
     monkeypatch.setattr(bench, "_fsdp_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_tp_overlap_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_ep_overlap_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_pp_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_obs_metrics", lambda t: {})
     _, r = _run_main(capsys, monkeypatch, tmp_path)
     # Fell back to the default 24-pair cap: ceil-stride over the 56
@@ -398,6 +406,8 @@ def test_main_multichip_device_sourced_cells(capsys, monkeypatch,
     )
     monkeypatch.setattr(bench, "_fsdp_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_tp_overlap_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_ep_overlap_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_pp_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_obs_metrics", lambda t: {})
     _, r = _run_main(capsys, monkeypatch, tmp_path)
     d = r["detail"]
@@ -753,6 +763,33 @@ def test_ep_overlap_metrics_cpu_mesh(monkeypatch):
     assert set(out) == set(bench.EP_NULL)
 
 
+@pytest.mark.slow  # tier-1 budget (round 10): two full pp=8 flagship
+# step compiles; the wave path's tier-1 compile coverage rides
+# tests/test_pp_overlap.py::test_wave_step_matches_one_shot_pp2 and
+# the schema/null wiring is pinned by PP_NULL's use in bench main().
+def test_pp_overlap_metrics_cpu_mesh(monkeypatch):
+    # The pp twin of test_ep_overlap_metrics_cpu_mesh: both modes
+    # build + run a real pp=8 flagship GPipe step (the wave ship's
+    # compile coverage on the full visible mesh), the losses agree,
+    # and the schema comes back filled. CPU records no device track,
+    # so the overlap fraction is an explicit null with the step times
+    # present.
+    from tpu_p2p.utils import timing
+
+    monkeypatch.setattr(
+        bench, "_measure",
+        lambda t, mc, x, iters, repeats=3, runs=2:
+            _fake_headline(host=2e-3),
+    )
+    out = bench._pp_overlap_metrics(timing)
+    assert out["pp_devices"] == 8
+    assert out["pp_step_ms_overlap_none"] == pytest.approx(2.0)
+    assert out["pp_step_ms_overlap_wave"] == pytest.approx(2.0)
+    assert out["pp_source"] == "host_differential"
+    assert out["pp_overlap_frac"] is None  # CPU: no device track
+    assert set(out) == set(bench.PP_NULL)
+
+
 def test_compact_line_fits_with_every_headline_key_at_realistic_width():
     # Satellite contract (round 7): the ≤1 KiB budget must hold with
     # ALL headline keys present at realistic numeric widths — i.e. the
@@ -780,6 +817,9 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         "ep_overlap_frac": 0.6543,
         "ep_step_ms_overlap_none": 123.456,
         "ep_step_ms_overlap_ring": 98.765,
+        "pp_overlap_frac": 0.5432,
+        "pp_step_ms_overlap_none": 123.456,
+        "pp_step_ms_overlap_wave": 98.765,
         "ring_achieved_gbps": 1234.56,
         "ag_achieved_gbps": 987.65,
         "obs_step_ms_p50": 123.456,
@@ -788,8 +828,6 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         "decode_hbm_ms_per_token": 0.0419,
         "flagship_large_tokens_per_s": 45467,
         "pairs_measured": 24,
-        "min_gbps": 123.456,
-        "max_gbps": 1234.567,
     }
     # Every headline key must have a realistic value in this test —
     # a key added to HEADLINE_KEYS without extending this table would

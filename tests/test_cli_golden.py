@@ -54,6 +54,15 @@ SUMMARY_PATTERNS = {
     "flagship_ep_ring": ["--cpu-mesh", "8", "--pattern",
                          "flagship_step", "--ep-overlap", "ring",
                          "--iters", "2"],
+    # The round-10 pp_overlap knob end to end: the flagship_step line
+    # must carry the active mode. Unlike the tp/ep pins (whose axes
+    # land size-1 on 8 devices), build_mesh factors 8 = sp2·dp2·pp2,
+    # so this pin runs REAL token-chunk wave ships on a pp=2 axis —
+    # plumbing, output contract, and the wave path end to end (the
+    # parity matrix itself lives in tests/test_pp_overlap.py).
+    "flagship_pp_wave": ["--cpu-mesh", "8", "--pattern",
+                         "flagship_step", "--pp-overlap", "wave",
+                         "--iters", "2"],
     # The round-8 obs subcommand end to end: live collective-ledger
     # capture (deterministic issue/byte totals on the 8-dev CPU mesh,
     # where no device track exists and the report says so) plus the

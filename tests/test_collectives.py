@@ -528,6 +528,126 @@ def test_ep_ring_chain_round_trip_identity_and_cached(rt, cache):
     assert cache.ep_ring_chain(rt.mesh, "d", 3, k=64) is fn  # cache hit
 
 
+# ------------------------------------------ chunked ppermute (wave)
+
+
+def test_chunked_ppermute_compute_matches_one_shot(rt):
+    # The wave decomposition must be *semantically* the one-shot
+    # ppermute of the computed buffer — asserted rank-locally against
+    # the raw collective inside one program, with a real per-chunk
+    # matmul so the compute hook is exercised, not just identity.
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.default_rng(4)
+    xg = rng.standard_normal((16, 6)).astype(np.float32)  # [t, k]
+    w = jnp.asarray(rng.standard_normal((6, 5)).astype(np.float32))
+    edges = C.ring_edges(8)
+
+    def f(x):
+        wave = C.chunked_ppermute_compute(
+            lambda c, _i: jnp.einsum("tk,kf->tf", c, w),
+            x, "d", edges, chunk_dim=0, chunks=4)
+        base = jax.lax.ppermute(jnp.einsum("tk,kf->tf", x, w), "d",
+                                edges)
+        return wave - base
+
+    diff = np.asarray(_sm(rt.mesh, f, P(None, None), P(None, None))(xg))
+    np.testing.assert_allclose(diff, 0.0, atol=0)
+
+
+def test_chunked_ppermute_compute_pads_nondivisible(rt):
+    # 10 tokens over 4 chunks: the trailing chunk zero-pads and the
+    # pad is sliced off after reassembly — values must stay bitwise
+    # the one-shot hop's (identity compute, the executors' case). The
+    # no-wraparound edge subset also pins partial edge sets (GPipe's
+    # last stage has no outgoing edge).
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    xg = np.arange(30, dtype=np.float32).reshape(10, 3)
+    edges = tuple((i, i + 1) for i in range(7))
+
+    def f(x):
+        wave = C.chunked_ppermute_compute(
+            lambda c, _i: c, x, "d", edges, chunk_dim=0, chunks=4)
+        return wave - jax.lax.ppermute(x, "d", edges)
+
+    diff = np.asarray(_sm(rt.mesh, f, P(None, None), P(None, None))(xg))
+    np.testing.assert_allclose(diff, 0.0, atol=0)
+
+
+def test_chunked_ppermute_compute_chunks1_degrades(rt):
+    # chunks=1 (and chunks > token count, which clamps) must take the
+    # one-shot branch — program-identical to ppermute(compute(x)).
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    xg = np.arange(6, dtype=np.float32).reshape(2, 3)
+    edges = C.ring_edges(8)
+
+    def f(x):
+        one = C.chunked_ppermute_compute(
+            lambda c, _i: 2.0 * c, x, "d", edges, chunk_dim=0, chunks=1)
+        clamped = C.chunked_ppermute_compute(
+            lambda c, _i: 2.0 * c, x, "d", edges, chunk_dim=0, chunks=9)
+        base = jax.lax.ppermute(2.0 * x, "d", edges)
+        return jnp.stack([one - base, clamped - base])
+
+    diff = np.asarray(_sm(rt.mesh, f, P(None, None),
+                          P(None, None, None))(xg))
+    np.testing.assert_allclose(diff, 0.0, atol=0)
+
+
+def test_chunked_ppermute_compute_records(rt):
+    # Ledger passthrough: one ppermute record per chunk at trace time
+    # (kind/axis/edges/label), so the obs join prices every wave hop.
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_p2p.obs import ledger as L
+
+    xg = np.arange(48, dtype=np.float32).reshape(16, 3)
+    edges = C.ring_edges(8)
+
+    def f(x):
+        return C.chunked_ppermute_compute(
+            lambda c, _i: c, x, "d", edges, chunk_dim=0, chunks=4,
+            label="wave_test")
+
+    led = L.CollectiveLedger()
+    with L.recording(led):
+        _sm(rt.mesh, f, P(None, None), P(None, None))(xg)
+    waves = [it for it in led.issues if it.label == "wave_test"]
+    assert len(waves) == 4
+    assert all(it.kind == "ppermute" and it.axis == "d" for it in waves)
+    # Each chunk carries 1/4 of the buffer's bytes.
+    assert all(it.payload_bytes == xg.nbytes // 4 for it in waves)
+
+
+def test_pp_wave_chain_round_trip_identity_and_cached(rt, cache):
+    # One hop = a chunked wave over the shift-by-1 ring through an
+    # identity matmul: after axis_size hops every payload is home —
+    # the identity round trip that makes it the measurable twin of
+    # permute_chain's monolithic hops on the same edges.
+    x = C.make_payload(rt.mesh, 2048, jnp.int8)
+    before = len(cache)
+    fn = cache.pp_wave_chain(rt.mesh, "d", 8, chunks=4, k=64)
+    assert len(cache) == before + 1
+    y = fn(x)
+    assert y.shape == x.shape and y.dtype == x.dtype
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+    assert cache.pp_wave_chain(rt.mesh, "d", 8, chunks=4, k=64) is fn
+    hits = cache.stats()["hits"]
+    assert cache.pp_wave_chain(rt.mesh, "d", 8, chunks=4, k=64) is fn
+    assert cache.stats()["hits"] == hits + 1
+    # Keyed by (count, chunks): a different chunking is a different
+    # compiled program, and a bounded cache evicts LRU-style.
+    small = C.CollectiveCache(maxsize=1)
+    small.pp_wave_chain(rt.mesh, "d", 8, chunks=2, k=64)
+    small.pp_wave_chain(rt.mesh, "d", 8, chunks=4, k=64)
+    assert small.stats()["evictions"] == 1 and len(small) == 1
+
+
 def test_instrumented_wrappers_match_raw_and_record(rt):
     # The model/ops-facing wrappers (psum / ppermute / all_to_all) are
     # pure passthroughs over jax.lax plus a trace-time ledger record —

@@ -309,12 +309,23 @@ def test_live_capture_on_cpu_mesh_records_but_no_track(rt):
     # Round 9: the capture also runs the ep-sharded MoE layer in both
     # ep_overlap modes, so the EP transport is priced — all_to_all
     # rows (mode "none") and ep-axis ppermute hops (mode "ring").
-    assert kinds == {"ppermute", "all_gather", "all_to_all"}
+    # Round 10: plus a GPipe pipeline forward in both pp_overlap
+    # modes, so the stage transport is priced too — pp-axis ppermute
+    # rows (one per tick under "none", one per token chunk under
+    # "wave") and the pp_output_replicate all_reduce.
+    assert kinds == {"ppermute", "all_gather", "all_to_all",
+                     "all_reduce"}
     totals = led.totals()
     assert totals[("all_to_all", "ep")]["issues"] == 2  # dispatch+combine
     assert totals[("all_to_all", "ep")]["wire_bytes"] > 0
     n = rt.mesh.devices.size
     assert totals[("ppermute", "ep")]["issues"] == 2 * (n - 1)
+    # pp stage hops: 1 scan-traced record (mode "none") + pp_chunks=2
+    # wave-chunk records (mode "wave"); one output-replicate psum per
+    # mode.
+    assert totals[("ppermute", "pp")]["issues"] == 3
+    assert totals[("ppermute", "pp")]["wire_bytes"] > 0
+    assert totals[("all_reduce", "pp")]["issues"] == 2
     assert join.no_device_track  # CPU records host events only
     s = io.StringIO()
     L.print_report(led, join, n=8, stream=s)

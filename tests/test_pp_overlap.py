@@ -1,0 +1,215 @@
+"""Token-chunk wave pipeline stage hops (``pp_overlap="wave"``):
+numerical parity of the chunked stage-hop waves with the one-shot
+ppermute baseline across mesh shapes, in both pipeline executors
+(GPipe autodiff and the manual interleaved 1F1B), under remat, on the
+LM config, with non-divisible token counts, and composed with the
+FSDP prefetch and tp-ring schedules — mirroring tests/test_ep_overlap
+.py's parity contract for the round-9 knob. The wave touches no
+arithmetic (identity chunk compute, no sum crosses a chunk boundary),
+so parity is BITWISE everywhere, not just at the pp=1/pp_chunks=1
+degrade; the asserts are exact.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpu_p2p.models import flagship as F
+
+
+def _mesh(names, shape):
+    n = int(np.prod(shape))
+    return Mesh(np.array(jax.devices()[:n]).reshape(shape), names)
+
+
+def _cfg(**kw):
+    base = dict(batch=8, seq=16, heads=4, head_dim=8, stages=2,
+                microbatches=2, num_experts=4, capacity_factor=8.0)
+    base.update(kw)
+    return F.FlagshipConfig(**base)
+
+
+def _assert_step_parity(mesh, base_kw, variant_kw=None, lm=False,
+                        one_f1b=False, pp_chunks=2, exact=True):
+    """One SGD step under pp_overlap='none' vs 'wave': loss and every
+    updated param agree bitwise. The wave ships the same bytes over
+    the same edges with identity chunk compute, so both schedules are
+    the same arithmetic in the same order. ``variant_kw`` adds extra
+    knobs to the wave side only (the compose cases: prefetch / tp
+    ring on top of the wave — ``exact=False`` there, because the
+    *added* schedule carries its own fusion-level tolerance, pinned in
+    its own suite); ``one_f1b`` runs the manual interleaved 1F1B
+    executor instead of the GPipe autodiff step.
+    """
+    cfg_n = _cfg(**base_kw)
+    cfg_w = _cfg(**{**base_kw, "pp_overlap": "wave",
+                    "pp_chunks": pp_chunks, **(variant_kw or {})})
+    params = F.init_flagship_params(cfg_n)
+    if one_f1b:
+        x, t = F.flagship_example_batch(cfg_n, mesh)
+        p_n = F.place_flagship_params_pipelined(params, mesh, cfg_n)
+        p_w = F.place_flagship_params_pipelined(params, mesh, cfg_w)
+        mk = F.make_flagship_train_step_1f1b
+    else:
+        if lm:
+            x, t = F.flagship_token_batch(cfg_n, mesh)
+            mk = F.make_flagship_lm_train_step
+        else:
+            x, t = F.flagship_example_batch(cfg_n, mesh)
+            mk = F.make_flagship_train_step
+        p_n = F.place_flagship_params(params, mesh, cfg_n)
+        p_w = F.place_flagship_params(params, mesh, cfg_w)
+    new_n, l_n = mk(mesh, cfg_n, lr=1e-2)(p_n, x, t)
+    new_w, l_w = mk(mesh, cfg_w, lr=1e-2)(p_w, x, t)
+    if exact:
+        assert float(l_w) == float(l_n)
+        for k in params:
+            np.testing.assert_array_equal(
+                np.asarray(new_w[k]), np.asarray(new_n[k]), err_msg=k)
+        return
+    np.testing.assert_allclose(float(l_w), float(l_n), rtol=1e-6)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(new_w[k]), np.asarray(new_n[k]),
+            atol=1e-5, rtol=1e-5, err_msg=k,
+        )
+
+
+# ------------------------------------------------------------ parity
+
+
+def test_wave_step_matches_one_shot_pp2():
+    # The tentpole parity contract on a pure-pp mesh: the GPipe tick's
+    # activation ship split into token-chunk waves must reproduce the
+    # one-shot-ppermute step bitwise.
+    _assert_step_parity(_mesh(("pp",), (2,)), dict())
+
+
+def test_wave_step_matches_one_shot_1f1b_pp2():
+    # The manual interleaved 1F1B executor ships BOTH directions per
+    # tick (activation fwd, gradient bwd); both waves must reproduce
+    # the one-shot hops bitwise through the per-tick vjp.
+    _assert_step_parity(_mesh(("pp",), (2,)), dict(), one_f1b=True)
+
+
+def test_wave_nondivisible_tokens_pad():
+    # pp_chunks=3 against T=16 local tokens: the trailing chunk is
+    # zero-padded and sliced off after reassembly — padded tokens must
+    # stay inert (the pipeline-bubble invariant), bitwise.
+    _assert_step_parity(_mesh(("pp",), (2,)), dict(), pp_chunks=3)
+
+
+@pytest.mark.slow  # tier-1 budget (round 10): the parity matrix rides
+# the uncapped full pass; tier-1 keeps the pp2 GPipe/1F1B cases + the
+# degrades below.
+@pytest.mark.parametrize(
+    "names,shape,one_f1b",
+    [(("dp", "pp"), (2, 2), False), (("tp", "pp"), (2, 2), False),
+     (("pp",), (4,), False), (("dp", "pp"), (2, 2), True),
+     (("tp", "pp"), (2, 2), True)],
+    ids=["dp2xpp2", "tp2xpp2", "pp4", "dp2xpp2_1f1b", "tp2xpp2_1f1b"])
+def test_wave_step_matches_one_shot_meshes(names, shape, one_f1b):
+    kw = dict()
+    if shape == (4,):
+        kw = dict(stages=4, microbatches=4)
+    _assert_step_parity(_mesh(names, shape), kw, one_f1b=one_f1b)
+
+
+@pytest.mark.slow
+def test_wave_matches_one_shot_under_remat():
+    # The wave sits on the scan-carry wire outside the checkpointed
+    # block, but the backward re-runs the mirrored reverse wave —
+    # gradients must not care.
+    _assert_step_parity(_mesh(("dp", "pp"), (2, 2)), dict(remat=True))
+
+
+@pytest.mark.slow
+def test_wave_lm_step_matches_one_shot():
+    # LM config with norm: the pipeline rides between the embed and
+    # the tied unembed, and the embedding's cotangent crosses the
+    # reverse-wave transposes — the gradient path the no-summing
+    # ppermute transpose structure keeps baseline-shaped.
+    _assert_step_parity(_mesh(("dp", "pp"), (2, 2)),
+                        dict(vocab=64, norm=True), lm=True)
+
+
+def test_wave_pp1_and_chunks1_degrade_bitwise():
+    # A 1-sized pp axis (and a mesh with no pp axis at all), and
+    # pp_chunks=1 on a real pp axis, must all take the byte-identical
+    # one-shot path: the knob is a no-op, bitwise. (Wave parity is
+    # bitwise everywhere, so the degrade assert is the same — what
+    # this pins is that the trivial shapes still compile and run.)
+    _assert_step_parity(_mesh(("dp", "pp"), (4, 1)), dict())
+    _assert_step_parity(_mesh(("dp",), (4,)), dict())
+    _assert_step_parity(_mesh(("pp",), (2,)), dict(), pp_chunks=1)
+
+
+# --------------------------------------------------------- composition
+
+
+@pytest.mark.slow
+def test_prefetch_and_pp_wave_compose():
+    # Satellite contract: overlap="prefetch" (FSDP double buffer over
+    # dp) + pp_overlap="wave" (stage-hop waves over pp) on a dp x pp
+    # mesh run together and stay parity with the plain zero_dp
+    # baseline — the two schedules touch different collective
+    # families (all-gather vs collective-permute). allclose, not
+    # bitwise: the PREFETCH side restructures the gather program
+    # (fusion-level drift, its own tolerance pinned in
+    # tests/test_fsdp.py); the wave adds nothing on top.
+    _assert_step_parity(_mesh(("dp", "pp"), (2, 2)),
+                        dict(zero_dp=True), dict(overlap="prefetch"),
+                        exact=False)
+
+
+@pytest.mark.slow
+def test_tp_ring_and_pp_wave_compose():
+    # tp_overlap="ring" (Megatron joins over tp) + pp_overlap="wave"
+    # (stage hops over pp) on a tp x pp mesh: the block-internal ring
+    # and the carry-wire wave both issue ppermutes, and the two
+    # schedules must compose against the double-"none" baseline. The
+    # tp ring reassociates its join sums, so THIS case is allclose,
+    # not bitwise — the wave side contributes no drift on top of the
+    # tp ring's own pinned tolerance (tests/test_tp_overlap.py).
+    mesh = _mesh(("tp", "pp"), (2, 2))
+    cfg_n = _cfg(tp_overlap="ring")
+    cfg_w = _cfg(tp_overlap="ring", pp_overlap="wave", pp_chunks=2)
+    params = F.init_flagship_params(cfg_n)
+    x, t = F.flagship_example_batch(cfg_n, mesh)
+    p_n = F.place_flagship_params(params, mesh, cfg_n)
+    p_w = F.place_flagship_params(params, mesh, cfg_w)
+    new_n, l_n = F.make_flagship_train_step(mesh, cfg_n, lr=1e-2)(p_n, x, t)
+    new_w, l_w = F.make_flagship_train_step(mesh, cfg_w, lr=1e-2)(p_w, x, t)
+    # Same tp-ring program either side of the wave: still bitwise.
+    assert float(l_w) == float(l_n)
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(new_w[k]), np.asarray(new_n[k]), err_msg=k)
+
+
+# ---------------------------------------------------------- validation
+
+
+def test_pp_overlap_knob_is_validated():
+    with pytest.raises(ValueError, match="pp_overlap"):
+        _cfg(pp_overlap="waves")
+    with pytest.raises(ValueError, match="pp_chunks"):
+        _cfg(pp_chunks=0)
+    assert _cfg(pp_overlap="wave").pp_overlap == "wave"
+    assert _cfg().pp_overlap == "none"
+    # The full quartet composition is a VALID config (validation must
+    # not forbid it) — pinned so a future validator cannot quietly
+    # outlaw what the compose tests exercise.
+    cfg = _cfg(zero_dp=True, overlap="prefetch", tp_overlap="ring",
+               ep_overlap="ring", pp_overlap="wave")
+    assert (cfg.overlap, cfg.tp_overlap, cfg.ep_overlap,
+            cfg.pp_overlap) == ("prefetch", "ring", "ring", "wave")
+
+
+def test_bench_config_pp_overlap_is_validated():
+    from tpu_p2p.config import BenchConfig
+
+    with pytest.raises(ValueError, match="pp_overlap"):
+        BenchConfig(pp_overlap="Wave")
+    assert BenchConfig(pp_overlap="wave").pp_overlap == "wave"

@@ -112,6 +112,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "decomposition of the dispatch/combine "
                         "all_to_alls, expert FFN einsums overlapped "
                         "with the hops; no-op at ep=1)")
+    p.add_argument("--pp-overlap", choices=("none", "wave"),
+                   default="none",
+                   help="flagship_step: pipeline stage-hop schedule "
+                        "(wave = the per-tick ppermute split into "
+                        "token-chunk waves, each chunk's transfer in "
+                        "flight under the remaining tick compute; "
+                        "no-op at pp=1)")
     p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
                    help="testing: force CPU platform with N simulated devices")
     p.add_argument("--list-devices", action="store_true",
@@ -152,6 +159,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         overlap=args.overlap,
         tp_overlap=args.tp_overlap,
         ep_overlap=args.ep_overlap,
+        pp_overlap=args.pp_overlap,
     )
 
 

@@ -142,6 +142,13 @@ class BenchConfig:
     # FlagshipConfig.ep_overlap, see tpu_p2p/parallel/collectives.py
     # ring_all_to_all_matmul / matmul_ring_all_to_all. No-op at ep=1;
     # other patterns ignore it.
+    pp_overlap: str = "none"  # flagship_step: pipeline stage-hop
+    # scheduling ("none" = one blocking ppermute per tick, "wave" =
+    # the hop split into token-chunk waves, each chunk's transfer in
+    # flight under the remaining tick compute); mirrors
+    # FlagshipConfig.pp_overlap, see tpu_p2p/parallel/collectives.py
+    # chunked_ppermute_compute. No-op at pp=1; other patterns
+    # ignore it.
 
     def __post_init__(self) -> None:
         if self.pattern not in PATTERNS:
@@ -172,6 +179,11 @@ class BenchConfig:
             raise ValueError(
                 f"unknown ep_overlap {self.ep_overlap!r}; expected "
                 "'none' or 'ring'"
+            )
+        if self.pp_overlap not in ("none", "wave"):
+            raise ValueError(
+                f"unknown pp_overlap {self.pp_overlap!r}; expected "
+                "'none' or 'wave'"
             )
 
     @property

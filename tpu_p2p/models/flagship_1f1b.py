@@ -182,6 +182,7 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
         loss_sum, grads = interleaved_grads_local(
             block_fn, _mse_loss_grad, params, x_mb, t_mb, sched, "pp",
             chunk_rows=s_chunk, vma_axes=data_axes, dparam_vma=dparam_vma,
+            pp_overlap=cfg.pp_overlap, pp_chunks=cfg.pp_chunks,
         )
         if data_axes:
             loss_sum = C.psum(loss_sum, data_axes, label="loss_allreduce")

@@ -101,6 +101,27 @@ class FlagshipConfig:
     # tp_overlap="ring" (tp×ep) — the three knobs schedule disjoint
     # collective families (all-gather / all-reduce / all-to-all).
     # Schedule + when "none" wins: docs/ep_overlap.md.
+    pp_overlap: str = "none"  # pipeline stage-hop scheduling (only
+    # meaningful with a pp axis > 1):
+    # "none" — each tick's activation (and, under the manual 1F1B
+    # executor, gradient) ships to the neighbor stage in ONE blocking
+    # ppermute — byte-identical baseline; the hop cannot start before
+    # the whole buffer exists and nothing pipelines against the tick.
+    # "wave" — the hop splits into pp_chunks token chunks
+    # (collectives.chunked_ppermute_compute): chunk c's ppermute is in
+    # flight while chunk c+1 (and the tick's trailing ops — the GPipe
+    # output record, the 1F1B forward block after the gradient wave)
+    # still compute, the autodiff transpose being the mirrored
+    # reverse-direction wave. Same bytes, no extra hops, and no sum
+    # crosses a chunk boundary, so loss/grads match elementwise;
+    # pp=1 and pp_chunks=1 degrade bitwise. Applies to the GPipe
+    # schedule scan, the manual 1F1B tick (both directions), and the
+    # flagship_1f1b executor; composes with overlap="prefetch",
+    # tp_overlap="ring", and ep_overlap="ring" (disjoint collective
+    # schedules). Schedule + when "none" wins: docs/pp_overlap.md.
+    pp_chunks: int = 4       # token chunks per wave ship (pp_overlap=
+    # "wave"); clamped to the local token count, non-divisible counts
+    # zero-padded (padded tokens stay inert — the bubble invariant).
     use_flash: bool = False  # Pallas flash kernel for the attention
     # math, trainable under every sp_strategy: Ulysses sees the full
     # sequence locally (the standalone custom-vjp kernel drops in);
@@ -194,6 +215,18 @@ class FlagshipConfig:
             raise ValueError(
                 f"unknown ep_overlap {self.ep_overlap!r}; expected "
                 "'none' or 'ring'"
+            )
+        # Strict like the other overlap knobs: a typo ("waves",
+        # "Wave") would silently train on the blocking-hop path while
+        # the run's logs claim the wave schedule.
+        if self.pp_overlap not in ("none", "wave"):
+            raise ValueError(
+                f"unknown pp_overlap {self.pp_overlap!r}; expected "
+                "'none' or 'wave'"
+            )
+        if self.pp_chunks < 1:
+            raise ValueError(
+                f"pp_chunks must be >= 1, got {self.pp_chunks}"
             )
         # Strict: a typo'd policy name must fail at config time, not
         # trace deep inside the step builder. hasattr alone is not

@@ -322,7 +322,9 @@ def _pipeline_schedule(stage_params, x_mb, cfg, s_local, pp, sp, tp, ep,
         return jnp.stack(
             [block_fn(stage_params, x_mb[i]) for i in range(x_mb.shape[0])]
         )
-    return pipeline_apply_local(block_fn, stage_params, x_mb, pp)
+    return pipeline_apply_local(block_fn, stage_params, x_mb, pp,
+                                pp_overlap=cfg.pp_overlap,
+                                pp_chunks=cfg.pp_chunks)
 
 
 def _forward_local(params, x, cfg: FlagshipConfig, mesh_axes,

@@ -89,7 +89,8 @@ def mlp_block(stage_params: Params, x):
 
 
 def pipeline_apply_local(block_fn: Callable, params_local: Params, x_mb,
-                         axis: str):
+                         axis: str, pp_overlap: str = "none",
+                         pp_chunks: int = 1):
     """GPipe schedule body — call inside ``shard_map`` over ``axis``.
 
     ``x_mb``: microbatched input ``[M, mb, T, D]``, replicated over the
@@ -100,11 +101,21 @@ def pipeline_apply_local(block_fn: Callable, params_local: Params, x_mb,
     during fill/drain bubbles); activations hop ``s → s+1`` on the
     no-wraparound neighbor edge set — the PP transport SURVEY.md §2.3
     maps onto this framework's ``ring`` workload.
+
+    ``pp_overlap="wave"`` (with ``pp_chunks > 1``) double-buffers the
+    stage hop: the tick's activation ship splits into ``pp_chunks``
+    token chunks through :func:`collectives.chunked_ppermute_compute`,
+    chunk ``c``'s ``ppermute`` in flight while chunk ``c+1`` (and the
+    tick's trailing output-record ops) are still computing — same
+    bytes, no extra hops, values elementwise identical to the one-shot
+    ship (docs/pp_overlap.md). ``"none"``, ``pp_chunks=1``, or a
+    1-sized axis keep the byte-identical monolithic hop.
     """
     s_count = jax.lax.axis_size(axis)
     my = jax.lax.axis_index(axis)
     m = x_mb.shape[0]
     edges = [(i, i + 1) for i in range(s_count - 1)]
+    wave = pp_overlap == "wave" and pp_chunks > 1 and s_count > 1
     # pcast-to-varying: the scan carry is device-varying over pp (axis_index is in
     # the tick), so its initial value must be typed varying too.
     zero = jax.lax.pcast(jnp.zeros_like(x_mb[0]), (axis,), to='varying')
@@ -119,9 +130,17 @@ def pipeline_apply_local(block_fn: Callable, params_local: Params, x_mb,
                          zero)
         x_in = jnp.where(my == 0, feed, prev_in)
         y = block_fn(params_local, x_in)
-        # Ship to the next stage (last stage's send has no edge).
-        y_next = (C.ppermute(y, axis, edges, label="pp_stage_ship")
-                  if s_count > 1 else zero)
+        # Ship to the next stage (last stage's send has no edge). The
+        # wave splits the hop into token-chunk waves (identity chunk
+        # compute: the block output already exists for the out_t
+        # recording below, so only the ship is chunked).
+        if wave:
+            y_next = C.chunked_ppermute_compute(
+                lambda c, _i: c, y, axis, edges, chunk_dim=1,
+                chunks=pp_chunks, label="pp_stage_ship")
+        else:
+            y_next = (C.ppermute(y, axis, edges, label="pp_stage_ship")
+                      if s_count > 1 else zero)
         # Last stage: record microbatch t - (S-1) once it's real.
         out_t = t - (s_count - 1)
         upd = jax.lax.dynamic_update_index_in_dim(
@@ -146,13 +165,16 @@ def _to_microbatches(x, m: int):
 
 
 def make_pipeline_forward(mesh: Mesh, cfg: PipelineConfig,
-                          block_fn: Callable = mlp_block):
+                          block_fn: Callable = mlp_block,
+                          pp_overlap: str = "none", pp_chunks: int = 1):
     """Jitted pipeline forward: global ``[B, T, D]`` in and out."""
     pp = _check_pp_mesh(mesh, cfg)
 
     def f(params, x):
         x_mb = _to_microbatches(x, cfg.microbatches)
-        y_mb = pipeline_apply_local(block_fn, params, x_mb, pp)
+        y_mb = pipeline_apply_local(block_fn, params, x_mb, pp,
+                                    pp_overlap=pp_overlap,
+                                    pp_chunks=pp_chunks)
         return y_mb.reshape(x.shape)
 
     sm = jax.shard_map(
@@ -175,14 +197,17 @@ def _check_pp_mesh(mesh: Mesh, cfg: PipelineConfig) -> str:
 
 
 def make_pipeline_train_step(mesh: Mesh, cfg: PipelineConfig,
-                             block_fn: Callable = mlp_block, lr: float = 1e-2):
+                             block_fn: Callable = mlp_block, lr: float = 1e-2,
+                             pp_overlap: str = "none", pp_chunks: int = 1):
     """One jitted SGD step through the pipeline schedule."""
     pp = _check_pp_mesh(mesh, cfg)
 
     def step(params, x, target):
         def local_loss(p):
             x_mb = _to_microbatches(x, cfg.microbatches)
-            y = pipeline_apply_local(block_fn, p, x_mb, pp)
+            y = pipeline_apply_local(block_fn, p, x_mb, pp,
+                                     pp_overlap=pp_overlap,
+                                     pp_chunks=pp_chunks)
             return jnp.sum(
                 (y.astype(jnp.float32)
                  - _to_microbatches(target, cfg.microbatches)

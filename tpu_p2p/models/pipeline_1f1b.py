@@ -239,7 +239,9 @@ def _mse_loss_grad(y, target):
 def make_pipeline_train_step_1f1b(mesh: Mesh, cfg: PipelineConfig,
                                   block_fn: Callable = mlp_block,
                                   lr: float = 1e-2,
-                                  loss_grad_fn: Callable = _mse_loss_grad):
+                                  loss_grad_fn: Callable = _mse_loss_grad,
+                                  pp_overlap: str = "none",
+                                  pp_chunks: int = 1):
     """One jitted SGD step under the 1F1B schedule.
 
     Drop-in equal to :func:`tpu_p2p.models.pipeline.make_pipeline_train_step`
@@ -259,4 +261,6 @@ def make_pipeline_train_step_1f1b(mesh: Mesh, cfg: PipelineConfig,
 
     _check_pp_mesh(mesh, cfg)
     return make_interleaved_train_step(mesh, cfg, 1, block_fn=block_fn,
-                                       lr=lr, loss_grad_fn=loss_grad_fn)
+                                       lr=lr, loss_grad_fn=loss_grad_fn,
+                                       pp_overlap=pp_overlap,
+                                       pp_chunks=pp_chunks)

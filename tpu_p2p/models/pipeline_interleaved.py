@@ -272,7 +272,9 @@ def interleaved_grads_local(block_fn: Callable, loss_grad_fn: Callable,
                             sched: InterleavedSchedule, axis: str,
                             chunk_rows: int = 1,
                             vma_axes: Tuple[str, ...] = (),
-                            dparam_vma=None):
+                            dparam_vma=None,
+                            pp_overlap: str = "none",
+                            pp_chunks: int = 1):
     """Run the interleaved schedule — call inside ``shard_map``.
 
     ``params_local`` leaves: the device's ``[v·chunk_rows, …]``
@@ -281,6 +283,16 @@ def interleaved_grads_local(block_fn: Callable, loss_grad_fn: Callable,
     ``[chunk_rows, …]`` param slice (a chunk may hold several
     consecutive sub-blocks, e.g. the flagship's transformer layers).
     Returns ``(loss_sum replicated over ``axis``, dparams_local)``.
+
+    ``pp_overlap="wave"`` (with ``pp_chunks > 1``): BOTH directions'
+    stage hops — the activation ship fwd and the gradient ship bwd —
+    split into ``pp_chunks`` token-chunk waves through
+    :func:`collectives.chunked_ppermute_compute`, each chunk's
+    ``ppermute`` issued under the remaining tick compute (the gradient
+    wave notably has the whole forward block still to run after ``dx``
+    exists) — same bytes, elementwise identical values, mirrored
+    transposes (docs/pp_overlap.md). ``"none"``/``pp_chunks=1`` keep
+    the byte-identical monolithic hops.
 
     ``vma_axes``: extra mesh axes of the *enclosing* shard_map the
     activation/gradient/loss carries must be typed varying over (the
@@ -407,10 +419,25 @@ def interleaved_grads_local(block_fn: Callable, loss_grad_fn: Callable,
         y_f = block_fn(chunk_of(params_local, f_cidx), x_in)
         y_f = jnp.where(f_on, y_f, zero_mb)
 
-        y_next = (C.ppermute(y_f, axis, fwd_edges, label="pp_fwd_ship")
-                  if n > 1 else y_f)
-        g_next = (C.ppermute(dx, axis, bwd_edges, label="pp_bwd_ship")
-                  if n > 1 else dx)
+        if n > 1 and pp_overlap == "wave" and pp_chunks > 1:
+            # Both directions ship as token-chunk waves (chunk_dim 1 of
+            # the [mb, T, D] microbatch): identity chunk compute — the
+            # values are already produced by the vjp/block above, only
+            # the hop is chunked so its transfers pipeline under the
+            # tick's remaining compute.
+            y_next = C.chunked_ppermute_compute(
+                lambda c, _i: c, y_f, axis, fwd_edges, chunk_dim=1,
+                chunks=pp_chunks, label="pp_fwd_ship")
+            g_next = C.chunked_ppermute_compute(
+                lambda c, _i: c, dx, axis, bwd_edges, chunk_dim=1,
+                chunks=pp_chunks, label="pp_bwd_ship")
+        else:
+            y_next = (C.ppermute(y_f, axis, fwd_edges,
+                                 label="pp_fwd_ship")
+                      if n > 1 else y_f)
+            g_next = (C.ppermute(dx, axis, bwd_edges,
+                                 label="pp_bwd_ship")
+                      if n > 1 else dx)
         return (x_stash, g_stash, y_next, g_next, dparams, loss_acc), None
 
     carry0 = (x_stash0, g_stash0, zero_mb,
@@ -426,7 +453,9 @@ def make_interleaved_train_step(mesh: Mesh, cfg: PipelineConfig,
                                 chunks: int,
                                 block_fn: Callable = mlp_block,
                                 lr: float = 1e-2,
-                                loss_grad_fn: Callable = _mse_loss_grad):
+                                loss_grad_fn: Callable = _mse_loss_grad,
+                                pp_overlap: str = "none",
+                                pp_chunks: int = 1):
     """One jitted SGD step under the interleaved 1F1B schedule.
 
     ``cfg.stages`` must equal ``pp_size · chunks``; params use the
@@ -448,7 +477,8 @@ def make_interleaved_train_step(mesh: Mesh, cfg: PipelineConfig,
         x_mb = _to_microbatches(x, cfg.microbatches)
         t_mb = _to_microbatches(target, cfg.microbatches)
         loss_sum, grads = interleaved_grads_local(
-            block_fn, loss_grad_fn, params, x_mb, t_mb, sched, pp
+            block_fn, loss_grad_fn, params, x_mb, t_mb, sched, pp,
+            pp_overlap=pp_overlap, pp_chunks=pp_chunks,
         )
         denom = float(np.prod(x.shape))
         new_params = jax.tree.map(

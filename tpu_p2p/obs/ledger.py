@@ -379,7 +379,13 @@ def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
     expert-parallel transport: the dispatch/combine ``all_to_all``
     rows (mode ``"none"``) and the ring decomposition's per-hop
     ``ppermute`` rows on the ``ep`` axis (mode ``"ring"``) — the
-    round-9 coverage the raw-a2a MoE used to leak past the ledger.
+    round-9 coverage the raw-a2a MoE used to leak past the ledger —
+    and a tiny GPipe pipeline forward run under BOTH ``pp_overlap``
+    modes, so the report also prices the pipeline stage transport: the
+    one-hop-per-tick ``pp_stage_ship`` ``ppermute`` rows on the ``pp``
+    axis (mode ``"none"``) and the wave decomposition's token-chunk
+    rows (mode ``"wave"`` — ``chunked_ppermute_compute``), the
+    round-10 coverage closing the overlap quartet.
     → ``(ledger, TraceJoin)``; on a 1-device mesh (no link
     exists) the ledger is empty and the join is empty too — but NOT
     marked ``no_device_track``: that flag means the platform records
@@ -393,6 +399,7 @@ def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
     from jax.sharding import Mesh as _Mesh
 
     from tpu_p2p.models import moe as M
+    from tpu_p2p.models import pipeline as PL
     from tpu_p2p.parallel import collectives as C
 
     axis = mesh.axis_names[0]
@@ -414,6 +421,21 @@ def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
         moe_layers.append(
             (M.make_moe_layer(ep_mesh, cfg), M.init_moe_params(cfg))
         )
+    # The pipeline PP pricing workload: one residual-MLP stage per
+    # rank under the GPipe schedule, fixed tiny shapes, run under both
+    # pp_overlap modes so the stage hop's ppermute rows land in the
+    # ledger in one-shot AND token-chunk-wave form.
+    pp_mesh = _Mesh(np.asarray(mesh.devices).reshape(-1), ("pp",))
+    pp_cfg = PL.PipelineConfig(d_model=8, d_ff=16, stages=n,
+                               microbatches=2)
+    pp_params = PL.place_pipeline_params(
+        PL.init_pipeline_params(pp_cfg), pp_mesh)
+    pp_x = jnp.zeros((2, 4, 8), jnp.float32)
+    pp_fwds = [
+        PL.make_pipeline_forward(pp_mesh, pp_cfg, pp_overlap=mode,
+                                 pp_chunks=2)
+        for mode in ("none", "wave")
+    ]
     with recording(led):
         ring = cache.permute_chain(mesh, axis, C.ring_edges(n), count)
         ag = cache.ag_chain(mesh, axis, count)
@@ -423,12 +445,16 @@ def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
         jax.block_until_ready(ag(payload))
         for layer, params in moe_layers:
             jax.block_until_ready(layer(params, moe_x))
+        for fwd in pp_fwds:
+            jax.block_until_ready(fwd(pp_params, pp_x))
     with tempfile.TemporaryDirectory(prefix="obs_cap_") as td:
         with jax.profiler.trace(td):
             jax.block_until_ready(ring(payload))
             jax.block_until_ready(ag(payload))
             for layer, params in moe_layers:
                 jax.block_until_ready(layer(params, moe_x))
+            for fwd in pp_fwds:
+                jax.block_until_ready(fwd(pp_params, pp_x))
         join = join_trace(led, td)
     return led, join
 

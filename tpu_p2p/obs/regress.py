@@ -76,6 +76,9 @@ TOLERANCES: Dict[str, Tolerance] = {
     "tp_step_ms_overlap_ring": Tolerance("lower", 0.25),
     "ep_overlap_frac": Tolerance("higher", 0.25),
     "ep_step_ms_overlap_ring": Tolerance("lower", 0.25),
+    # PR 5 pp-wave keys (bench.py _pp_overlap_metrics).
+    "pp_overlap_frac": Tolerance("higher", 0.25),
+    "pp_step_ms_overlap_wave": Tolerance("lower", 0.25),
     # PR 3 obs keys (bench.py _obs_metrics).
     "ring_achieved_gbps": Tolerance("higher", 0.25),
     "ag_achieved_gbps": Tolerance("higher", 0.25),

@@ -547,6 +547,69 @@ def matmul_ring_all_to_all(compute_chunk: Callable, x, axis: str,
     return out
 
 
+def chunked_ppermute_compute(compute_chunk: Callable, x, axis: str,
+                             edges: Sequence[Edge], chunk_dim: int,
+                             chunks: int, *,
+                             label: str = "chunked_ppermute_compute"):
+    """Ship ``compute(x)`` over ``edges`` as a *wave* of chunk hops:
+    chunk ``c``'s ``ppermute`` is issued the moment its compute
+    finishes, so chunk ``c+1``'s compute — and every trailing op with
+    no data dependency on the arrivals — runs while the transfer is in
+    flight. The pipeline-stage-hop member of the decomposition family
+    (`ring_allgather_matmul` / `matmul_ring_reducescatter` /
+    `ring_all_to_all_matmul`), applied to an arbitrary fixed edge set
+    instead of a shift ring: the pp transport is one neighbor-edge
+    permute per tick, and this splits it into ``chunks`` independent
+    transfers a latency-hiding scheduler can pipeline against the tick
+    compute instead of one monolithic hop that cannot start until the
+    whole buffer exists (docs/pp_overlap.md).
+
+    Semantics: exactly ``jax.lax.ppermute(concat_c(compute_chunk(x_c,
+    c)), axis, edges)`` for any per-chunk-independent ``compute_chunk``
+    — same bytes, no extra hops, and (the identity-compute case the
+    pipeline executors use) elementwise IDENTICAL values, since no
+    arithmetic reassociates. ``x`` splits along ``chunk_dim`` into
+    ``chunks`` equal chunks, zero-padded when the dim does not divide
+    (padded rows ride the wave and are sliced off after reassembly —
+    callers' computes must be zero-inert there, the pipeline-bubble
+    invariant); ``compute_chunk(x_c, c) → y_c`` must be shape-uniform
+    across chunks and preserve ``chunk_dim``'s extent.
+
+    Differentiable: each hop's transpose is the reverse-edge permute
+    (no cross-rank summing — the PR-2 probe's rule), and the
+    slice/concat transposes land on disjoint offsets, so the backward
+    is the mirrored reverse-direction wave with the baseline's exact
+    gradient structure. ``chunks <= 1`` degrades to the one-shot
+    ``ppermute(compute_chunk(x, 0))`` — bitwise the baseline ship.
+    """
+    edges = tuple((int(s), int(d)) for s, d in edges)
+    size = x.shape[chunk_dim]
+    chunks = max(1, min(int(chunks), max(1, size)))
+    if chunks <= 1:
+        # One-shot degrade: ledger-recorded through the same wrapper
+        # every other model-layer hop uses, so the rows never drift.
+        return ppermute(compute_chunk(x, 0), axis, edges, label=label)
+    pad = -(-size // chunks) * chunks - size
+    if pad:
+        widths = [(0, 0)] * x.ndim
+        widths[chunk_dim] = (0, pad)
+        x = jnp.pad(x, widths)
+    ct = (size + pad) // chunks
+    arrivals = []
+    for c in range(chunks):
+        xc = jax.lax.slice_in_dim(x, c * ct, (c + 1) * ct, axis=chunk_dim)
+        # Compute chunk c, ship it immediately (via the instrumented
+        # wrapper): the arrival's only consumer is the trailing
+        # concat, so chunk c+1's compute (and the caller's remaining
+        # tick ops) overlap the transfer.
+        arrivals.append(ppermute(compute_chunk(xc, c), axis, edges,
+                                 label=label))
+    out = jnp.concatenate(_promote_vma(arrivals), axis=chunk_dim)
+    if pad:
+        out = jax.lax.slice_in_dim(out, 0, size, axis=chunk_dim)
+    return out
+
+
 # -- instrumented one-shot wrappers -----------------------------------
 # Thin passthroughs over the jax.lax collectives for MODEL/OPS code:
 # identical semantics (autodiff, vma typing), plus one trace-time
@@ -1128,6 +1191,58 @@ class CollectiveCache:
                         lambda c, _d: jnp.einsum("ecf,fk->eck", c, w),
                         h, axis, split_dim=1, concat_dim=0)
                     return back.astype(carry.dtype).reshape(shape), None
+
+                out, _ = jax.lax.scan(step, x, None, length=count)
+                return out
+
+            return jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+            )
+
+        return self._get(key, build)
+
+    def pp_wave_chain(self, mesh: Mesh, axis: str, count: int,
+                      chunks: int = 4, k: int = 64):
+        """``count`` chained wave stage-hops — one hop is
+        :func:`chunked_ppermute_compute` over the shift-by-1 ring edge
+        set (the pipeline transport's wraparound closure, so the chain
+        is shape- AND value-preserving: after ``axis_size`` hops every
+        payload is home again — the identity round trip), the
+        payload's token view computed through a fixed ``[k, k]``
+        identity matmul in ``chunks`` chunks, each chunk's ``ppermute``
+        issued under the next chunk's matmul. Scans like
+        :meth:`permute_chain`; the benchmark twin of the flagship
+        ``pp_overlap="wave"`` stage ship, measurable against
+        :meth:`permute_chain` on the same edges (the same bytes in one
+        monolithic hop) the way :meth:`tp_ring_chain` measures against
+        :meth:`rs_ag_chain`.
+
+        The payload's trailing dim is viewed as ``[elems // k, k]``
+        tokens × features (``elems % k == 0`` required); the identity
+        weight passes values through unchanged (pure transport +
+        per-chunk launch cost, same note as :meth:`tp_ring_chain`).
+        """
+        key = ("pp_wave_chain", mesh, axis, count, chunks, k)
+
+        def build():
+            spec = P(*mesh.axis_names, None)
+            edges = ring_edges(mesh.shape[axis])
+
+            def f(x):
+                if x.shape[-1] % k:
+                    raise ValueError(
+                        f"payload {x.shape[-1]} elems not divisible by "
+                        f"feature dim {k}")
+                shape = x.shape
+                w = jnp.eye(k, dtype=x.dtype)
+
+                def step(carry, _):
+                    y = carry.reshape(-1, k)
+                    out = chunked_ppermute_compute(
+                        lambda c, _i: jnp.einsum("tk,kf->tf", c, w), y,
+                        axis, edges, chunk_dim=0, chunks=chunks,
+                        label="pp_wave_chain")
+                    return out.astype(carry.dtype).reshape(shape), None
 
                 out, _ = jax.lax.scan(step, x, None, length=count)
                 return out

@@ -502,6 +502,15 @@ def _build_parser() -> argparse.ArgumentParser:
                         "dispatch/combine all_to_alls, expert FFN "
                         "einsums overlapped with the hops; no-op at "
                         "ep=1)")
+    p.add_argument("--pp-overlap", default="none",
+                   choices=("none", "wave"),
+                   help="pipeline stage-hop schedule (wave = each "
+                        "tick's ppermute split into --pp-chunks "
+                        "token-chunk waves, transfers in flight under "
+                        "the remaining tick compute; no-op at pp=1)")
+    p.add_argument("--pp-chunks", type=int, default=4,
+                   help="token chunks per wave stage hop "
+                        "(--pp-overlap wave)")
     return p
 
 
@@ -532,6 +541,7 @@ def main(argv=None) -> int:
         norm=args.norm, dense_ffn=args.dense_ffn, rope=args.rope,
         remat=args.remat, zero_dp=args.zero_dp, overlap=args.overlap,
         tp_overlap=args.tp_overlap, ep_overlap=args.ep_overlap,
+        pp_overlap=args.pp_overlap, pp_chunks=args.pp_chunks,
     )
     summary = run_training(
         mesh, cfg, steps=args.steps, lr=args.lr, seed=args.seed,

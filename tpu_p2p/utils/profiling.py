@@ -46,6 +46,7 @@ __all__ = [
     "gather_overlap_fraction",
     "tp_overlap_fraction",
     "ep_overlap_fraction",
+    "pp_overlap_fraction",
     "validate_differential",
     "measure_headline",
 ]
@@ -582,6 +583,29 @@ def ep_overlap_fraction(trace_dir: str, window=None) -> Optional[dict]:
     return gather_overlap_fraction(
         trace_dir, names=("all-to-all", "collective-permute"),
         window=window)
+
+
+def pp_overlap_fraction(trace_dir: str, window=None) -> Optional[dict]:
+    """Fraction of device collective-permute time hidden under
+    concurrent compute — the ``pp_overlap="wave"`` metric
+    (``bench.py``'s ``pp_overlap_frac``), the pipeline twin of
+    :func:`gather_overlap_fraction` / :func:`tp_overlap_fraction`.
+
+    The pipeline stage hop is a neighbor-edge ``ppermute`` in BOTH
+    modes — one monolithic transfer per tick under ``"none"``, a
+    token-chunk wave per tick under ``"wave"``
+    (``tpu_p2p/parallel/collectives.py chunked_ppermute_compute``) —
+    and XLA lowers either to ``collective-permute(-start/-done)``
+    device events, so one capture reads the stage transport's hidden
+    share in either mode (on the bench's pure-pp mesh no other permute
+    family runs; mixed tp×pp / sp×pp meshes share the event name and
+    need a pure mesh to attribute). Same return contract as the twins:
+    ``None`` without a device track, ``frac=None`` when no
+    collective-permute exists in the capture (pp=1 — nothing to hide).
+    """
+    return gather_overlap_fraction(trace_dir,
+                                   names=("collective-permute",),
+                                   window=window)
 
 
 def differential_from_trace(trace_dir: str, n_short: int, n_long: int,

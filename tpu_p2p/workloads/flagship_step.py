@@ -49,6 +49,12 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
         # combine reshards (tpu_p2p/models/moe.py ep_overlap="ring");
         # degrades to the one-shot a2a path on ep=1 meshes.
         mc = dataclasses.replace(mc, ep_overlap=cfg.ep_overlap)
+    if model_cfg is None and cfg.pp_overlap != "none":
+        # --pp-overlap wave: the token-chunked stage-hop waves
+        # (tpu_p2p/models/pipeline.py pipeline_apply_local +
+        # collectives.chunked_ppermute_compute); degrades to the
+        # one-shot ppermute on pp=1 meshes.
+        mc = dataclasses.replace(mc, pp_overlap=cfg.pp_overlap)
     # mc as the placement cfg: with zero_dp the param specs carry the
     # ZeRO dp dim, and placing without it would materialize full
     # replicas (the memory ZeRO exists to avoid) + a first-step
@@ -80,11 +86,13 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
                    if mc.tp_overlap != "none" else "")
         ep_part = (f" ep_overlap={mc.ep_overlap}"
                    if mc.ep_overlap != "none" else "")
+        pp_part = (f" pp_overlap={mc.pp_overlap}"
+                   if mc.pp_overlap != "none" else "")
         sys.stdout.write(
             f"flagship_step mesh {axes} {mc.sp_strategy}-SP "
             f"B{mc.batch} T{mc.seq} H{mc.heads} E{mc.num_experts} "
             f"S{mc.stages}x{mc.microbatches}mb {mc.dtype}"
-            f"{tp_part}{ep_part}: "
+            f"{tp_part}{ep_part}{pp_part}: "
             f"p50 {s.p50 * 1e3:.2f}ms/step  {tok_s:,.0f} tokens/s\n"
         )
         sys.stdout.flush()
@@ -95,6 +103,7 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
             mesh=str(axes), sp_strategy=mc.sp_strategy,
             batch=mc.batch, seq=mc.seq, tokens_per_s=tok_s,
             tp_overlap=mc.tp_overlap, ep_overlap=mc.ep_overlap,
+            pp_overlap=mc.pp_overlap,
         )
     )
     return {"mesh": axes, "p50_ms": s.p50 * 1e3, "tokens_per_s": tok_s}
