@@ -64,4 +64,8 @@ train:
 clean:
 	rm -f $(NATIVE_SO)
 	rm -rf __pycache__ docs/__pycache__ .pytest_cache
+	rm -rf tpu_p2p/parallel/__pycache__
 	find tpu_p2p tests -name __pycache__ -type d -prune -exec rm -rf {} + 2>/dev/null || true
+	# Pallas/Mosaic lowering caches the round-11 dma kernels can leave
+	# behind (real-TPU runs; interpret mode writes none).
+	rm -rf .mosaic_cache mosaic_cache __pallas_cache__ .pallas_cache

@@ -72,20 +72,20 @@ HEADLINE_KEYS = (
     "latency_8b_p50_us",
     "latency_8b_oneop_p50_us",
     "fsdp_overlap_frac",
-    "fsdp_step_ms_overlap_none",
     "fsdp_step_ms_overlap_prefetch",
     "tp_overlap_frac",
-    "tp_step_ms_overlap_none",
     "tp_step_ms_overlap_ring",
     "ep_overlap_frac",
-    "ep_step_ms_overlap_none",
     "ep_step_ms_overlap_ring",
     "pp_overlap_frac",
-    "pp_step_ms_overlap_none",
     "pp_step_ms_overlap_wave",
     "ring_achieved_gbps",
     "ag_achieved_gbps",
     "obs_step_ms_p50",
+    "p2p_lat_us_xla",
+    "p2p_lat_us_pallas",
+    "ring_gbps_xla",
+    "ring_gbps_pallas",
     "flagship_step_ms",
     "decode_ms_per_token",
     "decode_hbm_ms_per_token",
@@ -97,6 +97,10 @@ HEADLINE_KEYS = (
     # never drift-guard quoted (tests/test_parity_drift.QUOTES), and
     # the matrix extremes still persist in BENCH_detail.json while the
     # line's top-level "value" carries the graded pairwise average.
+    # Round 11 applied the same rule to the four *_step_ms_overlap_none
+    # baselines (never gated — only the overlap variants are; still in
+    # BENCH_detail.json) to make room for the dma-transport quartet
+    # p2p_lat_us_{xla,pallas} / ring_gbps_{xla,pallas}.
 )
 
 
@@ -966,6 +970,22 @@ def _obs_metrics(timing):
             # drift) must not claim device-trace-sourced output.
             if ring is not None or ag is not None:
                 out["obs_source"] = "device_trace"
+            # The carried-over multi-chip deliverable: persist the
+            # per-link N×N achieved-Gbps matrix as a MULTICHIP_r*
+            # artifact whenever a device trace joined (real meshes) —
+            # guarded so an artifact-write failure never discards the
+            # metrics above.
+            try:
+                from tpu_p2p.obs.regress import write_multichip_artifact
+
+                written = write_multichip_artifact(
+                    join, n, artifacts_dir=os.path.dirname(
+                        _detail_path()) or ".")
+                if written:
+                    print(f"# wrote {written}", file=sys.stderr)
+            except Exception as e:  # noqa: BLE001
+                print(f"# multichip artifact write failed: {e!r}",
+                      file=sys.stderr)
     from tpu_p2p.models import flagship as F
     from tpu_p2p.train import run_training
 
@@ -977,6 +997,107 @@ def _obs_metrics(timing):
         s = run_training(mesh1, cfg, steps=6, lr=1e-2, log_every=0,
                          obs_jsonl=os.path.join(td, "obs.jsonl"))
     out["obs_step_ms_p50"] = s.get("obs_step_ms_p50")
+    return out
+
+
+# Null shape of _dma_transport_metrics — capability-probe failure (or
+# any measurement crash) must produce the same keys (schema stability,
+# mirroring FSDP_NULL / TP_NULL / EP_NULL / PP_NULL / OBS_NULL), with
+# dma_probe_error naming WHY the nulls published.
+DMA_NULL = {
+    "dma_supported": None,
+    "p2p_lat_us_xla": None,
+    "p2p_lat_us_pallas": None,
+    "ring_gbps_xla": None,
+    "ring_gbps_pallas": None,
+    "dma_probe_error": None,
+    "dma_source": None,
+}
+
+DMA_RING_BYTES = 1024 * 1024  # ring-busbw rung payload per device
+DMA_LAT_ITERS = 512  # 8 B chain hops for the latency slope
+DMA_RING_ITERS = 16
+
+
+def _dma_transport_metrics(timing):
+    """XLA-vs-Pallas transport head-to-head (round 11 tentpole): the
+    same shift-by-1 ring chain compiled over both permute backends —
+    ``transport="xla"`` (CollectivePermute) and ``"pallas_dma"`` (raw
+    ``make_async_remote_copy`` kernels, tpu_p2p/parallel/pallas_dma.py)
+    — measured by the same device-trace-preferred machinery as every
+    headline.
+
+    ``p2p_lat_us_{xla,pallas}``: per-hop time of an 8 B chain — the
+    latency floor the matrix exists to expose; the XLA number carries
+    whatever scheduling overhead CollectivePermute lowers to, the
+    Pallas number is the raw-DMA rung below it.
+    ``ring_gbps_{xla,pallas}``: per-device link busbw of the same ring
+    at 1 MiB. On a single chip the ring degenerates to the self-edge:
+    XLA deletes the identity (the number is the program floor) while
+    the DMA kernel performs a REAL local loopback copy — both are
+    honest floors of their own transport and say so via ``devices``.
+
+    Capability-probe failure (``runtime.pallas_dma_supported``) or a
+    non-TPU interpret-mode backend publishes the ``DMA_NULL`` schema /
+    interpret-sourced values with ``dma_probe_error`` naming the
+    reason — interpret timing is discharge-emulation speed, never a
+    transport claim, so the pallas keys stay null there while the
+    plumbing is still exercised end to end.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_p2p.parallel import collectives as C
+    from tpu_p2p.parallel import runtime as RT
+
+    out = dict(DMA_NULL)
+    out["dma_supported"] = RT.pallas_dma_supported()
+    if not out["dma_supported"]:
+        out["dma_probe_error"] = RT.pallas_dma_probe_error()
+        return out
+    from tpu_p2p.parallel.pallas_dma import interpret_default
+
+    interp = interpret_default()
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.asarray(devs).reshape(n), ("d",))
+    cache = C.CollectiveCache()
+    edges = C.ring_edges(n)
+    x_lat = C.make_payload(mesh, 8)
+    x_ring = C.make_payload(mesh, DMA_RING_BYTES)
+    for name, transport in (("xla", "xla"), ("pallas", "pallas_dma")):
+        if transport == "pallas_dma" and interp:
+            # Interpret mode emulates the DMA with gathers — recording
+            # its "latency" next to real XLA numbers would grade the
+            # emulator. The probe already proved parity; keep nulls.
+            out["dma_probe_error"] = (
+                "interpret-mode backend: parity only, no timing"
+            )
+            continue
+        chain = lambda k, t=transport: cache.permute_chain(  # noqa: E731
+            mesh, "d", edges, k, transport=t)
+        # Per-transport guard: the tiny capability probe passing does
+        # not guarantee the 1 MiB ring or the long scanned chain
+        # lowers (Mosaic shape limits &c) — a pallas failure must not
+        # discard the XLA keys already measured into ``out``, and the
+        # reason must publish instead of a bare DMA_NULL.
+        try:
+            m = _measure(timing, chain, x_lat, DMA_LAT_ITERS, repeats=3)
+            if m.per_op_s:
+                out[f"p2p_lat_us_{name}"] = round(m.per_op_s * 1e6, 4)
+                out["dma_source"] = m.source
+            m = _measure(timing, chain, x_ring, DMA_RING_ITERS,
+                         repeats=3)
+            if m.per_op_s:
+                out[f"ring_gbps_{name}"] = round(
+                    timing.gbps(DMA_RING_BYTES, m.per_op_s), 3)
+                out["dma_source"] = m.source
+        except Exception as e:  # noqa: BLE001 — headline must publish
+            out["dma_probe_error"] = (
+                f"{transport} measurement failed: "
+                f"{type(e).__name__}: {e}"
+            )
     return out
 
 
@@ -1800,6 +1921,16 @@ def main() -> int:
         print(f"# obs measurement failed: {e!r}", file=sys.stderr)
         obs_m = {}
     result["detail"].update({k: obs_m.get(k) for k in OBS_NULL})
+    # XLA-vs-Pallas transport head-to-head (round-11 tentpole): the
+    # p2p latency floor and ring busbw over both permute backends,
+    # DMA_NULL schema on capability-probe failure.
+    try:
+        dma_m = _dma_transport_metrics(timing)
+    except Exception as e:  # noqa: BLE001 — same rationale
+        print(f"# dma transport measurement failed: {e!r}",
+              file=sys.stderr)
+        dma_m = {}
+    result["detail"].update({k: dma_m.get(k) for k in DMA_NULL})
 
     detail_path = _detail_path()
     try:

@@ -63,6 +63,15 @@ SUMMARY_PATTERNS = {
     "flagship_pp_wave": ["--cpu-mesh", "8", "--pattern",
                          "flagship_step", "--pp-overlap", "wave",
                          "--iters", "2"],
+    # The round-11 pallas_dma transport end to end on the 8-device
+    # mesh: the full uni-directional matrix over raw async-remote-copy
+    # kernels (interpret mode on CPU), --check asserting every cell's
+    # rank-tagged payload actually arrived through the DMA path. The
+    # title/summary carry the active transport; every Gbps magnitude
+    # masks (interpret-mode discharge speed is not a number).
+    "p2p_pallas": ["--cpu-mesh", "8", "--pattern", "pairwise",
+                   "--direction", "uni", "--transport", "pallas_dma",
+                   "--check", "--iters", "2", "--msg-size", "4KiB"],
     # The round-8 obs subcommand end to end: live collective-ledger
     # capture (deterministic issue/byte totals on the 8-dev CPU mesh,
     # where no device track exists and the report says so) plus the

@@ -83,3 +83,74 @@ def test_lint_scans_the_expected_trees():
     names = {os.path.basename(p) for p in files}
     assert "moe.py" in names and "attention.py" in names, sorted(names)
     assert len(files) >= 15, files
+
+
+# ---------------------------------------------------- pallas transport
+# Round 11: the raw-DMA transport (pl.pallas_call +
+# pltpu.make_async_remote_copy) must stay behind the instrumented
+# wrappers in tpu_p2p/parallel/ (pallas_dma.py kernels, collectives.py
+# recording) and the kernel library in tpu_p2p/ops/ — a pallas_call in
+# model/workload/obs code would move bytes the ledger never sees AND
+# bypass the runtime capability probe, the exact class of hole the
+# jax.lax lint above closes for XLA collectives.
+
+_PALLAS_CALL = re.compile(
+    r"(?:pl\.pallas_call|pltpu\.make_async_remote_copy)\s*\("
+)
+
+PALLAS_ALLOWED = ("parallel", "ops")
+
+
+def _all_pkg_files():
+    for dirpath, _dirs, files in os.walk(PKG):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def test_pallas_transport_only_under_parallel_and_ops():
+    offenders = []
+    for path in _all_pkg_files():
+        rel = os.path.relpath(path, PKG)
+        if rel.split(os.sep)[0] in PALLAS_ALLOWED:
+            continue
+        with open(path) as fh:
+            for lineno, line in enumerate(fh, 1):
+                if _PALLAS_CALL.search(line):
+                    offenders.append(
+                        f"tpu_p2p/{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "pl.pallas_call / pltpu.make_async_remote_copy outside "
+        "tpu_p2p/parallel/ and tpu_p2p/ops/ bypasses the collective "
+        "ledger and the pallas_dma capability probe. Route transport "
+        "through collectives.dma_ppermute / the CollectiveCache "
+        "pallas programs:\n  " + "\n  ".join(offenders)
+    )
+
+
+def test_pallas_lint_pattern_catches_calls_and_ignores_prose():
+    # Self-test, like the jax.lax lint's: call sites only.
+    assert _PALLAS_CALL.search("out = pl.pallas_call(kern, ...)")
+    assert _PALLAS_CALL.search(
+        "op = pltpu.make_async_remote_copy (src_ref=a, dst_ref=b)")
+    assert not _PALLAS_CALL.search(
+        "# built on ``pltpu.make_async_remote_copy`` + semaphores")
+    assert not _PALLAS_CALL.search(
+        "the ``pl.pallas_call`` interpret path")
+
+
+def test_pallas_lint_sees_the_kernel_modules():
+    # The allowlisted trees must actually contain the kernels — if
+    # pallas_dma.py moves, the lint must start failing, not silently
+    # allowlist nothing.
+    hits = []
+    for sub in PALLAS_ALLOWED:
+        for dirpath, _dirs, files in os.walk(os.path.join(PKG, sub)):
+            for f in files:
+                if not f.endswith(".py"):
+                    continue
+                with open(os.path.join(dirpath, f)) as fh:
+                    if _PALLAS_CALL.search(fh.read()):
+                        hits.append(f)
+    assert "pallas_dma.py" in hits, hits
+    assert "flash_attention.py" in hits, hits

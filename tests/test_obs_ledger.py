@@ -313,8 +313,13 @@ def test_live_capture_on_cpu_mesh_records_but_no_track(rt):
     # modes, so the stage transport is priced too — pp-axis ppermute
     # rows (one per tick under "none", one per token chunk under
     # "wave") and the pp_output_replicate all_reduce.
+    # Round 11: plus the Pallas raw-DMA ring twin (kind="dma") when
+    # the capability probe passes — it does on the CPU interpret path.
     assert kinds == {"ppermute", "all_gather", "all_to_all",
-                     "all_reduce"}
+                     "all_reduce", "dma"}
+    totals_dma = led.totals().get(("dma", "d"))
+    assert totals_dma is not None and totals_dma["issues"] == 4
+    assert totals_dma["wire_bytes"] == totals_dma["payload_bytes"]
     totals = led.totals()
     assert totals[("all_to_all", "ep")]["issues"] == 2  # dispatch+combine
     assert totals[("all_to_all", "ep")]["wire_bytes"] > 0

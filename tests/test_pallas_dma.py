@@ -1,0 +1,514 @@
+"""Round-11 Pallas DMA transport: parity, chains, fusion, ledger.
+
+The acceptance pin: ``transport="pallas_dma"`` (raw
+``make_async_remote_copy`` kernels, tpu_p2p/parallel/pallas_dma.py)
+produces BITWISE-identical results to ``transport="xla"``
+(CollectivePermute) for every edge-set shape the framework uses —
+rings, shifted rings, partial edge sets, bidirectional pairs, empty
+sets — on the tier-1 interpret-mode meshes, plus the fused-kernel
+variants of the gather ring and the chunk wave, behind the single
+runtime-level capability probe.
+"""
+
+import io
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tests.test_profiling import _ev, _meta, _write_trace
+from tpu_p2p.obs import ledger as L
+from tpu_p2p.parallel import collectives as C
+from tpu_p2p.parallel import pallas_dma as PD
+from tpu_p2p.parallel import runtime as RT
+
+MiB = 1024 * 1024
+
+
+@pytest.fixture(scope="module")
+def cache():
+    return C.CollectiveCache()
+
+
+def _host(x):
+    return np.asarray(x)
+
+
+# ------------------------------------------------------------- probe
+
+
+def test_capability_probe_passes_on_interpret_backend():
+    # The single gate every caller sits behind: on the simulated CPU
+    # mesh the interpret-mode kernels must work, so the probe is True
+    # and carries no error.
+    assert RT.pallas_dma_supported() is True
+    assert RT.pallas_dma_probe_error() is None
+
+
+def test_capability_gate_raises_backenderror_with_reason(monkeypatch):
+    from tpu_p2p.utils.errors import BackendError
+
+    monkeypatch.setattr(RT, "_PALLAS_DMA_OK", False)
+    monkeypatch.setattr(RT, "_PALLAS_DMA_ERR", "synthetic: no mosaic")
+    fresh = C.CollectiveCache()
+    mesh = Mesh(np.array(jax.devices()[:2]), ("d",))
+    with pytest.raises(BackendError, match="synthetic: no mosaic"):
+        fresh.permute(mesh, "d", ((0, 1),), transport="pallas_dma")
+
+
+def test_unknown_transport_rejected(cache, rt):
+    with pytest.raises(ValueError, match="unknown transport"):
+        cache.permute(rt.mesh, "d", ((0, 1),), transport="nccl")
+    with pytest.raises(ValueError, match="unknown transport"):
+        C.chunked_ppermute_compute(lambda x, c: x, jnp.zeros((4, 2)),
+                                   "d", ((0, 1),), 0, 2,
+                                   transport="nccl")
+
+
+# ------------------------------------------- permutation completion
+
+
+def test_complete_permutation_total_and_deterministic():
+    dst, src, has_in = PD.complete_permutation([(0, 3)], 4)
+    # Real edge kept; dummies pair unmatched senders with unmatched
+    # receivers in sorted order: senders {1,2,3} -> receivers {0,1,2}.
+    assert dst[0] == 3
+    assert sorted(dst.tolist()) == [0, 1, 2, 3]  # total permutation
+    assert list(has_in) == [False, False, False, True]
+    assert (dst[src[np.arange(4)]] == np.arange(4)).all()  # inverse
+    again = PD.complete_permutation([(0, 3)], 4)
+    assert (again[0] == dst).all()
+
+
+def test_complete_permutation_rejects_non_partial_permutation():
+    with pytest.raises(ValueError, match="duplicate"):
+        PD.complete_permutation([(0, 1), (0, 2)], 4)
+    with pytest.raises(ValueError, match="duplicate"):
+        PD.complete_permutation([(0, 1), (2, 1)], 4)
+    with pytest.raises(ValueError, match="out of range"):
+        PD.complete_permutation([(0, 9)], 4)
+
+
+# ---------------------------------------------------- bitwise parity
+
+# Edge-set shapes: full shift rings, a shifted ring, a single pair
+# (the matrix cell), a bidirectional pair (the full-duplex cell), a
+# scattered partial set, and empty (everyone zeros).
+EDGE_SETS = {
+    "ring": C.ring_edges(8),
+    "shift3": C.ring_edges(8, shift=3),
+    "unidir": C.unidir_edges(2, 5),
+    "bidir": C.bidir_edges(1, 6),
+    "partial": ((0, 1), (3, 2), (6, 4)),
+    "empty": (),
+}
+
+
+@pytest.mark.parametrize("name", sorted(EDGE_SETS))
+def test_dma_ppermute_bitwise_matches_xla(rt, cache, name):
+    edges = EDGE_SETS[name]
+    # 136 int8 elems: NOT divisible by any lane width — the kernel's
+    # (1, n) flat view must not care (non-divisible padding case).
+    x = C.make_payload(rt.mesh, 136, jnp.int8)
+    want = _host(cache.permute(rt.mesh, "d", edges)(x)) if edges else \
+        np.zeros_like(_host(x))
+    got = _host(cache.permute(rt.mesh, "d", edges,
+                              transport="pallas_dma")(x))
+    np.testing.assert_array_equal(got, want)
+    # And against the host oracle directly (not just the XLA twin).
+    np.testing.assert_array_equal(
+        got, C.expected_permute(_host(x), edges))
+
+
+def test_dma_ppermute_float_payload_parity(rt, cache):
+    # float32 at a non-1 trailing shape via the raw primitive inside a
+    # hand-built shard_map (the cache path always flattens payloads).
+    mesh = rt.mesh
+    edges = ((0, 2), (2, 0), (5, 7))
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((8, 5, 3)),
+        jnp.float32)
+
+    def run(transport):
+        def f(v):
+            if transport == "xla":
+                return C.ppermute(v, "d", edges)
+            return C.dma_ppermute(v, "d", edges)
+        sm = C._shard_map_unchecked(f, mesh, P("d", None, None),
+                                    P("d", None, None))
+        return _host(jax.jit(sm)(x))
+
+    np.testing.assert_array_equal(run("pallas_dma"), run("xla"))
+
+
+# ------------------------------------------------------------ chains
+
+
+def test_dma_permute_chain_ring_round_trip(rt, cache):
+    # Shift-by-1 ring: axis_size hops is the identity round trip —
+    # value-preserving, so the chain is self-checking.
+    x = C.make_payload(rt.mesh, 64, jnp.int8)
+    fn = cache.dma_permute_chain(rt.mesh, "d", C.ring_edges(8), 8)
+    np.testing.assert_array_equal(_host(fn(x)), _host(x))
+
+
+def test_dma_permute_chain_matches_xla_chain(rt, cache):
+    x = C.make_payload(rt.mesh, 64, jnp.int8)
+    got = _host(cache.dma_permute_chain(rt.mesh, "d",
+                                        C.ring_edges(8, shift=2), 3)(x))
+    want = _host(cache.permute_chain(rt.mesh, "d",
+                                     C.ring_edges(8, shift=2), 3)(x))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_dma_chain_cache_hit_and_distinct_key(rt):
+    fresh = C.CollectiveCache()
+    edges = C.ring_edges(8)
+    a = fresh.dma_permute_chain(rt.mesh, "d", edges, 4)
+    misses = fresh.stats()["misses"]
+    b = fresh.dma_permute_chain(rt.mesh, "d", edges, 4)
+    assert a is b  # cache hit on the same (mesh, edges, count, transport)
+    assert fresh.stats()["misses"] == misses
+    assert fresh.stats()["hits"] >= 1
+    # The XLA chain on the SAME tuple is a different program.
+    c = fresh.permute_chain(rt.mesh, "d", edges, 4)
+    assert c is not a
+    assert fresh.stats()["misses"] == misses + 1
+
+
+def test_transport_xla_is_bitwise_noop(rt):
+    # The default spelling and the explicit transport="xla" resolve to
+    # the SAME cached program (same key) — the knob cannot perturb any
+    # pre-round-11 number by construction.
+    fresh = C.CollectiveCache()
+    edges = C.bidir_edges(0, 3)
+    a = fresh.permute(rt.mesh, "d", edges)
+    b = fresh.permute(rt.mesh, "d", edges, transport="xla")
+    assert a is b
+    x = C.make_payload(rt.mesh, 128, jnp.int8)
+    np.testing.assert_array_equal(_host(a(x)), _host(b(x)))
+
+
+# ------------------------------------------------------------ ledger
+
+
+def test_ledger_records_dma_rows_per_hop(rt):
+    fresh = C.CollectiveCache()
+    edges = C.ring_edges(8)
+    led = L.CollectiveLedger()
+    with L.recording(led):
+        fn = fresh.dma_permute_chain(rt.mesh, "d", edges, 5)
+        jax.block_until_ready(fn(C.make_payload(rt.mesh, 256)))
+    rows = [it for it in led.issues if it.kind == "dma"]
+    assert len(rows) == 1  # scan body traced once ...
+    assert rows[0].count == 5  # ... expanded to one row per hop
+    assert rows[0].edges == edges
+    assert rows[0].wire_bytes == rows[0].payload_bytes  # per-link
+    assert led.totals()[("dma", "d")]["issues"] == 5
+
+
+def test_wire_bytes_dma_prices_like_ppermute():
+    assert L.wire_bytes("dma", 8, MiB) == L.wire_bytes("ppermute", 8, MiB)
+    assert L.kind_of_event("jit_f.dma_transport_ppermute.3") == "dma"
+    assert L.kind_of_event("dma_transport_ship_compute") == "dma"
+    # Generic dma-ish device events do NOT map (layout copies etc.).
+    assert L.kind_of_event("dynamic-update-slice.dma") is None
+
+
+# ----------------------------------------------------- fused kernels
+
+
+def _tp_mesh(n=4):
+    return Mesh(np.array(jax.devices()[:n]), ("tp",))
+
+
+def test_fused_ring_allgather_matmul_rank_local_equivalence():
+    # The gather ring through a REAL matmul, both transports,
+    # rank-local bitwise: the fused kernel computes the identical
+    # einsum on the identical chunk values, only the ship differs.
+    mesh = _tp_mesh(4)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)  # [t,k]
+    w = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+
+    def run(transport):
+        def f(xs, ws):
+            return C.ring_allgather_matmul(
+                lambda c, s: jnp.einsum("tk,kf->tf", c, ws), xs,
+                "tp", gather_dim=0, transport=transport)
+        sm = C._shard_map_unchecked(
+            f, mesh, (P("tp", None), P(None, None)), P(None, None))
+        return _host(jax.jit(sm)(x, w))
+
+    got, want = run("pallas_dma"), run("xla")
+    np.testing.assert_array_equal(got, want)
+    # And against the undecomposed truth.
+    np.testing.assert_allclose(
+        got, _host(jnp.einsum("tk,kf->tf", x, w)), rtol=1e-5)
+
+
+def test_fused_ring_uses_traced_src_index():
+    # compute_chunk consumes the traced ring origin (the flagship ring
+    # join's contract): src rides the kernel as an SMEM scalar operand.
+    mesh = _tp_mesh(4)
+    x = jnp.asarray(np.arange(8 * 4, dtype=np.float32).reshape(8, 4))
+
+    def run(transport):
+        def f(xs):
+            return C.ring_allgather_matmul(
+                lambda c, s: c + s.astype(c.dtype), xs, "tp",
+                gather_dim=0, transport=transport)
+        sm = C._shard_map_unchecked(f, mesh, P("tp", None),
+                                    P(None, None))
+        return _host(jax.jit(sm)(x))
+
+    np.testing.assert_array_equal(run("pallas_dma"), run("xla"))
+
+
+@pytest.mark.parametrize("edges,chunks,t", [
+    (C.ring_edges(4), 2, 8),      # full ring, divisible
+    (((0, 1), (1, 2), (2, 3)), 3, 7),  # partial edges + padding
+])
+def test_fused_wave_chunked_ppermute_parity(edges, chunks, t):
+    mesh = _tp_mesh(4)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((t, 4)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((4, 4)), jnp.float32)
+
+    def run(transport):
+        def f(xs, ws):
+            return C.chunked_ppermute_compute(
+                lambda c, i: jnp.dot(c, ws), xs, "tp", edges,
+                chunk_dim=0, chunks=chunks, transport=transport)
+        sm = C._shard_map_unchecked(
+            f, mesh, (P(None, None), P(None, None)), P(None, None))
+        return _host(jax.jit(sm)(x, w))
+
+    np.testing.assert_array_equal(run("pallas_dma"), run("xla"))
+
+
+def test_fused_wave_chunks_one_degrade_uses_dma_ship():
+    # chunks<=1 degrades to ONE one-shot ship — through the dma
+    # wrapper under the pallas transport (ledger row kind="dma").
+    mesh = _tp_mesh(4)
+    x = jnp.asarray(np.arange(4 * 2, dtype=np.float32).reshape(4, 2))
+    led = L.CollectiveLedger()
+
+    def f(xs):
+        return C.chunked_ppermute_compute(
+            lambda c, i: c, xs, "tp", C.ring_edges(4), 0, 1,
+            transport="pallas_dma")
+
+    sm = jax.jit(C._shard_map_unchecked(f, mesh, P(None, None),
+                                        P(None, None)))
+    with L.recording(led):
+        got = _host(sm(x))
+    assert [it.kind for it in led.issues] == ["dma"]
+    want_f = jax.jit(C._shard_map_unchecked(
+        lambda xs: C.chunked_ppermute_compute(
+            lambda c, i: c, xs, "tp", C.ring_edges(4), 0, 1),
+        mesh, P(None, None), P(None, None)))
+    np.testing.assert_array_equal(got, _host(want_f(x)))
+
+
+def test_fused_ship_compute_gradients_match_xla_ring():
+    # The fused kernel's custom_vjp (reverse-edge DMA for the ship
+    # cotangent + ordinary vjp of the hoisted compute) vs the XLA
+    # ring's autodiff — dx AND dw, the tp/pp overlap rings' actual
+    # backward contract.
+    mesh = _tp_mesh(4)
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((8, 4)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((4, 4)), jnp.float32)
+
+    def grads(transport):
+        def loss(xs, ws):
+            y = C.ring_allgather_matmul(
+                lambda c, s: jnp.dot(c, ws), xs, "tp",
+                gather_dim=0, transport=transport)
+            return jnp.sum(y * y)
+        sm = C._shard_map_unchecked(
+            lambda xs, ws: jax.grad(loss, argnums=(0, 1))(xs, ws),
+            mesh, (P("tp", None), P(None, None)),
+            (P("tp", None), P(None, None)))
+        dx, dw = jax.jit(sm)(x, w)
+        return _host(dx), _host(dw)
+
+    (dx_d, dw_d), (dx_x, dw_x) = grads("pallas_dma"), grads("xla")
+    np.testing.assert_allclose(dx_d, dx_x, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(dw_d, dw_x, rtol=1e-6, atol=1e-6)
+
+
+def test_fused_compute_closing_over_concrete_constant():
+    # A compute that closes over a CONCRETE array (constant-folded
+    # weight): closure_convert leaves it baked as a jaxpr constant,
+    # which pallas_call rejects — dma_ship_compute must lift it to a
+    # kernel operand (the XLA transport accepts the same closure).
+    mesh = _tp_mesh(4)
+    W = jnp.asarray(
+        np.random.default_rng(5).standard_normal((4, 4)), jnp.float32)
+    x = jnp.asarray(
+        np.random.default_rng(6).standard_normal((8, 4)), jnp.float32)
+
+    def run(transport):
+        def f(xs):
+            return C.ring_allgather_matmul(
+                lambda c, s: jnp.dot(c, W), xs, "tp",
+                gather_dim=0, transport=transport)
+        sm = C._shard_map_unchecked(f, mesh, P("tp", None),
+                                    P(None, None))
+        return _host(jax.jit(sm)(x))
+
+    np.testing.assert_array_equal(run("pallas_dma"), run("xla"))
+
+
+def test_probe_not_poisoned_by_trace_time_first_use(monkeypatch):
+    # Regression: the primitives call the capability gate at TRACE
+    # time (inside shard_map/jit). If that is the process's first
+    # probe, it cannot run eagerly there — it must fail OPEN without
+    # caching a spurious False, and the program must still build.
+    monkeypatch.setattr(RT, "_PALLAS_DMA_OK", None)
+    monkeypatch.setattr(RT, "_PALLAS_DMA_ERR", None)
+    mesh = _tp_mesh(4)
+    x = jnp.asarray(np.arange(8 * 2, dtype=np.float32).reshape(8, 2))
+
+    def f(xs):
+        return C.ring_allgather_matmul(
+            lambda c, s: c * 2.0, xs, "tp", gather_dim=0,
+            transport="pallas_dma")
+
+    sm = C._shard_map_unchecked(f, mesh, P("tp", None), P(None, None))
+    got = _host(jax.jit(sm)(x))  # first gate call happens mid-trace
+    np.testing.assert_array_equal(got, _host(x) * 2.0)
+    assert RT._PALLAS_DMA_OK is not False  # no poisoned cache
+    assert RT.pallas_dma_supported() is True  # eager probe still runs
+
+
+def test_dma_ppermute_gradient_is_reverse_permute():
+    # The custom_vjp transpose: d/dx sum(g * permute(x)) must equal
+    # the REVERSE permute of g — the same structure as lax.ppermute's.
+    mesh = _tp_mesh(4)
+    edges = ((0, 2), (1, 3), (3, 0))
+    x = jnp.asarray(np.arange(4 * 3, dtype=np.float32).reshape(4, 3))
+    g = jnp.asarray(
+        np.random.default_rng(3).standard_normal((4, 3)),
+        jnp.float32)
+
+    def grad_of(permute):
+        def f(xs, gs):
+            return jnp.sum(permute(xs) * gs)
+        sm = C._shard_map_unchecked(
+            lambda xs, gs: jax.grad(f)(xs, gs), mesh,
+            (P("tp", None), P("tp", None)), P("tp", None))
+        return _host(jax.jit(sm)(x, g))
+
+    got = grad_of(lambda v: PD.dma_ppermute(v, "tp", edges))
+    want = grad_of(lambda v: jax.lax.ppermute(v, "tp", edges))
+    np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------------- report + multichip artifact
+
+
+def _joined_trace(tmp_path, n=4):
+    """Synthetic device-tracked join carrying one XLA ppermute and one
+    dma_transport event over the same ring — the head-to-head shape."""
+    led = L.CollectiveLedger()
+    edges = [(i, (i + 1) % n) for i in range(n)]
+    with L.recording(led):
+        L.record_issue("ppermute", "d", nbytes=MiB, axis_size=n,
+                       edges=edges, count=1)
+        L.record_issue("dma", "d", nbytes=MiB, axis_size=n,
+                       edges=edges, count=1)
+    events = [_meta(3, "/device:TPU:0"),
+              _ev(3, 1, "jit_chain(1)", 0.0, 1e6),
+              _ev(3, 1, "collective-permute.1", 100.0, 400.0),
+              _ev(3, 1, "jit_x.dma_transport_ppermute.1", 600.0, 100.0)]
+    return led, L.join_trace(led, _write_trace(tmp_path, events))
+
+
+def test_link_matrix_kind_filter_separates_transports(tmp_path):
+    _led, join = _joined_trace(tmp_path)
+    both = join.link_matrix(4)
+    xla = join.link_matrix(4, kinds=("ppermute",))
+    dma = join.link_matrix(4, kinds=("dma",))
+    # 1 MiB over 400us (xla) vs 100us (dma); the unfiltered matrix
+    # pools both transfers over both durations.
+    assert xla[0][1] == pytest.approx(MiB * 8 / 400e-6 / 1e9, rel=1e-3)
+    assert dma[0][1] == pytest.approx(MiB * 8 / 100e-6 / 1e9, rel=1e-3)
+    assert both[0][1] == pytest.approx(2 * MiB * 8 / 500e-6 / 1e9,
+                                       rel=1e-3)
+    assert math.isnan(xla[0][2])  # no traffic off the ring edges
+
+
+def test_print_report_renders_head_to_head_matrices(tmp_path):
+    led, join = _joined_trace(tmp_path)
+    s = io.StringIO()
+    L.print_report(led, join, n=4, stream=s)
+    out = s.getvalue()
+    assert "Pallas-DMA P2P Achieved Bandwidth" in out
+    assert "ledger per-link achieved (pallas_dma)" in out
+    # The XLA matrix excludes the dma rows when both are present.
+    assert out.index("Achieved Bandwidth (Gbps)") < out.index(
+        "Pallas-DMA P2P Achieved Bandwidth")
+
+
+def test_multichip_artifact_written_and_never_clobbers(tmp_path):
+    import json
+
+    from tpu_p2p.obs import regress as R
+
+    _led, join = _joined_trace(tmp_path)
+    # Seed an existing driver artifact: the writer must continue the
+    # sequence, never overwrite.
+    seed = os.path.join(tmp_path, "MULTICHIP_r07.json")
+    with open(seed, "w") as fh:
+        fh.write("{}")
+    path = R.write_multichip_artifact(join, 4, artifacts_dir=str(tmp_path))
+    assert os.path.basename(path) == "MULTICHIP_r08.json"
+    with open(path) as fh:
+        art = json.load(fh)
+    assert art["kind"] == "obs_link_matrix"
+    assert art["n_devices"] == 4
+    # XLA and Pallas matrices split head-to-head; NaN cells are null.
+    assert art["matrix_gbps"][0][1] is not None
+    assert art["matrix_gbps"][0][2] is None
+    assert art["matrix_gbps_dma"][0][1] is not None
+    assert art["per_kind"]["dma"]["events"] == 1
+    with open(seed) as fh:  # untouched
+        assert fh.read() == "{}"
+
+
+def test_multichip_artifact_skipped_without_device_track(tmp_path):
+    from tpu_p2p.obs import regress as R
+
+    join = L.TraceJoin(no_device_track=True)
+    assert R.write_multichip_artifact(join, 4,
+                                      artifacts_dir=str(tmp_path)) is None
+    assert R.write_multichip_artifact(L.TraceJoin(), 4,
+                                      artifacts_dir=str(tmp_path)) is None
+    assert not [f for f in os.listdir(tmp_path)
+                if f.startswith("MULTICHIP")]
+
+
+# --------------------------------------------------- config plumbing
+
+
+def test_benchconfig_transport_validation():
+    from tpu_p2p.config import BenchConfig
+
+    assert BenchConfig(transport="pallas_dma").transport == "pallas_dma"
+    with pytest.raises(ValueError, match="unknown transport"):
+        BenchConfig(transport="nvlink")
+
+
+def test_cli_parses_transport_flag():
+    from tpu_p2p.cli import build_parser, config_from_args
+
+    args = build_parser().parse_args(
+        ["--pattern", "latency", "--transport", "pallas_dma"])
+    assert config_from_args(args).transport == "pallas_dma"

@@ -67,7 +67,10 @@ def test_jsonl_roundtrip(tmp_path):
     w.write(rec)
     w.close()
     done = report.load_done_cells(path)
-    assert done[("pairwise", "uni", 0, 1, 1024, "serialized")] == 12.5
+    # Records without a transport field predate round 11 and were all
+    # XLA-measured — the loader keys them as such.
+    assert done[("pairwise", "uni", 0, 1, 1024, "serialized",
+                 "xla")] == 12.5
 
 
 def test_jsonl_resume_skips_torn_lines(tmp_path):
@@ -78,7 +81,26 @@ def test_jsonl_resume_skips_torn_lines(tmp_path):
     ).to_json()
     path.write_text(good + "\n{\"workload\": \"torn\n")
     done = report.load_done_cells(str(path))
-    assert list(done) == [("w", "uni", 1, 2, 64, "fused")]
+    assert list(done) == [("w", "uni", 1, 2, 64, "fused", "xla")]
+
+
+def test_jsonl_resume_keys_split_by_transport(tmp_path):
+    # An xla-measured cell must never satisfy a pallas_dma rerun of
+    # the same (workload, ..., mode) cell on resume — transport rides
+    # the key (workloads/base.cell_record stamps it via extra).
+    path = str(tmp_path / "cells.jsonl")
+    w = report.JsonlWriter(path)
+    for transport, gbps in (("xla", 1.0), ("pallas_dma", 2.0)):
+        w.write(report.CellRecord(
+            workload="pairwise", direction="uni", src=0, dst=1,
+            msg_bytes=64, iters=1, mode="fused", gbps=gbps,
+            extra={"transport": transport},
+        ))
+    w.close()
+    done = report.load_done_cells(path)
+    assert done[("pairwise", "uni", 0, 1, 64, "fused", "xla")] == 1.0
+    assert done[("pairwise", "uni", 0, 1, 64, "fused",
+                 "pallas_dma")] == 2.0
 
 
 def test_jsonl_writer_none_path_is_noop():

@@ -24,6 +24,7 @@ from tpu_p2p.config import (
     ISOLATIONS,
     MODES,
     PATTERNS,
+    TRANSPORTS,
     parse_size,
     parse_sweep,
 )
@@ -58,6 +59,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mode", choices=MODES, default="serialized",
                    help="serialized = one message in flight (reference semantics); "
                         "fused = device-chained hops, no host dispatch")
+    p.add_argument("--transport", choices=TRANSPORTS, default="xla",
+                   help="permute transport for pairwise/latency/loopback "
+                        "pairs: xla = CollectivePermute (default); "
+                        "pallas_dma = raw async remote copies "
+                        "(make_async_remote_copy Pallas kernels — the "
+                        "sub-XLA backend; interpret-mode on non-TPU, "
+                        "gated by a capability probe)")
     p.add_argument("--isolation", choices=ISOLATIONS, default="full",
                    help="full = one N-device program per pair; submesh = 2-device mesh per pair")
     p.add_argument("--num-devices", type=int, default=None,
@@ -144,6 +152,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         direction=args.direction,
         mode=args.mode,
         isolation=args.isolation,
+        transport=args.transport,
         num_devices=args.num_devices,
         mesh_shape=mesh_shape,
         sweep=parse_sweep(args.sweep) if args.sweep else None,

@@ -87,6 +87,13 @@ MODES = ("serialized", "fused", "differential", "device")
 # records which source it published.
 ISOLATIONS = ("full", "submesh")  # SURVEY.md §7 hard part (a)
 DIRECTIONS = ("uni", "bi", "both")
+TRANSPORTS = ("xla", "pallas_dma")
+# xla = CollectivePermute programs (the default — every number before
+# round 11 was measured over it); pallas_dma = raw async remote copies
+# (pltpu.make_async_remote_copy kernels, tpu_p2p/parallel/pallas_dma.py)
+# behind the runtime capability probe — the sub-XLA backend that
+# strips the ~0.55 µs program-dispatch floor off the p2p matrix and
+# latency workloads (docs/pallas_dma.md).
 
 
 @dataclass
@@ -142,6 +149,13 @@ class BenchConfig:
     # FlagshipConfig.ep_overlap, see tpu_p2p/parallel/collectives.py
     # ring_all_to_all_matmul / matmul_ring_all_to_all. No-op at ep=1;
     # other patterns ignore it.
+    transport: str = "xla"  # permute-family transport backend for the
+    # pairwise / latency / loopback-pair workloads: "xla" =
+    # CollectivePermute (default, bitwise the pre-knob behavior),
+    # "pallas_dma" = raw async-remote-copy Pallas kernels
+    # (collectives.dma_ppermute; gated by runtime.pallas_dma_supported,
+    # a BackendError names the probe failure otherwise). Collective
+    # patterns (allreduce &c) have no permute transport and ignore it.
     pp_overlap: str = "none"  # flagship_step: pipeline stage-hop
     # scheduling ("none" = one blocking ppermute per tick, "wave" =
     # the hop split into token-chunk waves, each chunk's transfer in
@@ -184,6 +198,11 @@ class BenchConfig:
             raise ValueError(
                 f"unknown pp_overlap {self.pp_overlap!r}; expected "
                 "'none' or 'wave'"
+            )
+        if self.transport not in TRANSPORTS:
+            raise ValueError(
+                f"unknown transport {self.transport!r}; expected one "
+                f"of {TRANSPORTS}"
             )
 
     @property

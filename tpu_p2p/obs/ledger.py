@@ -73,8 +73,15 @@ __all__ = [
 
 # Ledger kinds → the substring their XLA device-op names carry.
 # Checked in order; "reduce-scatter" and "collective-permute" must
-# precede the shorter matches they contain pieces of.
+# precede the shorter matches they contain pieces of. "dma" is the
+# Pallas raw-remote-copy transport (tpu_p2p/parallel/pallas_dma.py):
+# its device events carry either the kernel-body name or the jitted
+# wrapper name (profiling.OP_CATEGORY_RULES' v5e precedent is the
+# WRAPPER, e.g. ``_flash_bwd_call.188``), which is why BOTH carry the
+# ``dma_transport`` prefix there — first in the table so a Pallas hop
+# can never mis-file under an XLA collective kind.
 KINDS = (
+    ("dma", "dma_transport"),
     ("ppermute", "collective-permute"),
     ("all_gather", "all-gather"),
     ("reduce_scatter", "reduce-scatter"),
@@ -82,6 +89,15 @@ KINDS = (
     ("all_reduce", "all-reduce"),
 )
 _KIND_NAMES = tuple(k for k, _ in KINDS)
+
+
+def non_dma_kinds():
+    """Every ledger kind except the pallas transport — the XLA side of
+    the head-to-head split. ONE definition, used by both
+    :func:`print_report` and ``regress.write_multichip_artifact`` so
+    the printed matrix and the MULTICHIP artifact can never filter
+    differently."""
+    return tuple(k for k in _KIND_NAMES if k != "dma")
 
 
 def kind_of_event(name: str) -> Optional[str]:
@@ -103,8 +119,12 @@ def wire_bytes(kind: str, axis_size: int, payload_bytes: int) -> int:
     module docstring for the per-kind algebra.
     """
     n = int(axis_size)
-    if kind == "ppermute":
-        return int(payload_bytes)  # per directed link
+    if kind in ("ppermute", "dma"):
+        # Per directed link — a raw-DMA hop ships the same bytes over
+        # the same edge as its CollectivePermute twin, so the two
+        # transports price identically and the head-to-head matrix is
+        # apples to apples.
+        return int(payload_bytes)
     if kind == "all_gather":
         return (n - 1) * int(payload_bytes)
     if kind == "reduce_scatter":
@@ -286,14 +306,19 @@ class TraceJoin:
             )
         return out
 
-    def link_matrix(self, n: Optional[int] = None) -> List[List[float]]:
-        """Per-link achieved Gbps from the edge-carrying (ppermute)
-        joined events: cell ``[src][dst]`` = total bytes over total
-        device seconds on that directed link; NaN where no ledger
-        traffic crossed it. Axis collectives (all-gather &c) have no
-        per-link attribution without assuming the ring algorithm — they
-        stay in :meth:`per_kind`/:meth:`per_axis`."""
-        edged = [j for j in self.joined if j.issue.edges]
+    def link_matrix(self, n: Optional[int] = None,
+                    kinds: Optional[Sequence[str]] = None) -> List[List[float]]:
+        """Per-link achieved Gbps from the edge-carrying (ppermute /
+        dma) joined events: cell ``[src][dst]`` = total bytes over
+        total device seconds on that directed link; NaN where no
+        ledger traffic crossed it. Axis collectives (all-gather &c)
+        have no per-link attribution without assuming the ring
+        algorithm — they stay in :meth:`per_kind`/:meth:`per_axis`.
+        ``kinds`` restricts the matrix to one transport (the
+        XLA-vs-Pallas head-to-head render in :func:`print_report`);
+        None keeps every edge-carrying kind."""
+        edged = [j for j in self.joined if j.issue.edges
+                 and (kinds is None or j.issue.kind in kinds)]
         if n is None:
             n = 1 + max(
                 (max(max(e) for e in j.issue.edges) for j in edged),
@@ -436,6 +461,19 @@ def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
                                  pp_chunks=2)
         for mode in ("none", "wave")
     ]
+    # The Pallas raw-DMA ring twin (round 11): the same shift-by-1
+    # edges over `transport="pallas_dma"` when the capability probe
+    # passes, so the report prices BOTH transports from one capture
+    # (kind="dma" rows; print_report renders the head-to-head
+    # matrices on device-tracked platforms). A small payload: the
+    # interpret-mode CPU path moves real bytes through the DMA
+    # discharge, and the ledger needs rows, not bandwidth.
+    from tpu_p2p.parallel.runtime import pallas_dma_supported
+    dma_ring = None
+    dma_payload = C.make_payload(mesh, min(msg_bytes, 64 * 1024))
+    if pallas_dma_supported():
+        dma_ring = cache.dma_permute_chain(mesh, axis,
+                                           C.ring_edges(n), count)
     with recording(led):
         ring = cache.permute_chain(mesh, axis, C.ring_edges(n), count)
         ag = cache.ag_chain(mesh, axis, count)
@@ -443,6 +481,8 @@ def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
         # compile time must not land inside the capture.
         jax.block_until_ready(ring(payload))
         jax.block_until_ready(ag(payload))
+        if dma_ring is not None:
+            jax.block_until_ready(dma_ring(dma_payload))
         for layer, params in moe_layers:
             jax.block_until_ready(layer(params, moe_x))
         for fwd in pp_fwds:
@@ -451,6 +491,8 @@ def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
         with jax.profiler.trace(td):
             jax.block_until_ready(ring(payload))
             jax.block_until_ready(ag(payload))
+            if dma_ring is not None:
+                jax.block_until_ready(dma_ring(dma_payload))
             for layer, params in moe_layers:
                 jax.block_until_ready(layer(params, moe_x))
             for fwd in pp_fwds:
@@ -496,10 +538,21 @@ def print_report(ledger: CollectiveLedger, join: TraceJoin, n: int,
         )
         out.flush()
         return
+    has_dma = any(j.issue.kind == "dma" for j in join.joined)
     rep = render_matrix(
-        join.link_matrix(n),
+        join.link_matrix(n, kinds=non_dma_kinds() if has_dma else None),
         f"Evaluating the {title} TPU P2P Achieved Bandwidth (Gbps)",
         stream=out,
     )
     rep.print_summary("ledger per-link achieved")
+    if has_dma:
+        # Head-to-head: the same links priced over the Pallas raw-DMA
+        # transport — the XLA dispatch floor vs raw ICI, per link.
+        rep_dma = render_matrix(
+            join.link_matrix(n, kinds=("dma",)),
+            f"Evaluating the {title} Pallas-DMA P2P Achieved "
+            "Bandwidth (Gbps)",
+            stream=out,
+        )
+        rep_dma.print_summary("ledger per-link achieved (pallas_dma)")
     out.flush()

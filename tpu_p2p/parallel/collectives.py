@@ -46,6 +46,64 @@ from tpu_p2p.obs.ledger import record_issue as _record_issue
 
 Edge = Tuple[int, int]
 
+# Transport backends for the permute-family primitives: "xla" lowers
+# to CollectivePermute (the default everywhere — byte-identical to the
+# pre-transport code paths), "pallas_dma" to raw async remote copies
+# (tpu_p2p/parallel/pallas_dma.py) behind the runtime capability probe.
+# ONE definition (config.py, a leaf module) governs the CLI choices,
+# BenchConfig validation, and the primitive-level check alike, so a
+# future transport cannot be accepted by one layer and rejected by
+# another.
+from tpu_p2p.config import TRANSPORTS  # noqa: E402
+
+
+def _check_transport(transport: str) -> str:
+    if transport not in TRANSPORTS:
+        raise ValueError(
+            f"unknown transport {transport!r}; expected one of "
+            f"{TRANSPORTS}"
+        )
+    return transport
+
+
+def _require_pallas_dma():
+    """→ the pallas_dma module, or raise BackendError with the cached
+    probe reason — every pallas build funnels through the ONE
+    runtime-level capability probe."""
+    from tpu_p2p.parallel import runtime as _rt
+    from tpu_p2p.utils.errors import BackendError
+
+    if not _rt.pallas_dma_supported():
+        raise BackendError(
+            "transport='pallas_dma' is unsupported on this backend: "
+            f"{_rt.pallas_dma_probe_error()}"
+        )
+    from tpu_p2p.parallel import pallas_dma as PD
+
+    return PD
+
+
+def _shard_map_unchecked(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` with replication/vma checking off — Pallas
+    kernels carry no vma type, so the dma-transport programs opt out
+    the way every published Pallas collective does (SNIPPETS.md [1]
+    ``check_rep=False``). Tries the current spelling first; the kwarg
+    was renamed (check_rep → check_vma) across jax versions. The bare
+    final attempt is a DELIBERATE best-effort: if some future jax
+    drops both kwargs, this builds a shard_map with that version's
+    default checking — which may have learned to type Pallas outputs
+    (then everything works) or may reject them (then
+    ``runtime.pallas_dma_supported`` caches False with the rejection
+    text as the probe reason). Either way the capability probe is the
+    gate; this helper must never be the thing that raises first."""
+    for kw in ({"check_vma": False}, {"check_rep": False}, {}):
+        try:
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kw)
+        except TypeError:
+            continue
+    raise AssertionError("unreachable: bare shard_map signature")
+
 # Multiplicative rank tag; coprime with 256 so per-rank patterns are
 # distinct in int8. Verification replaces the reference's unchecked
 # zero buffers (p2p_matrix.cc:129-130).
@@ -284,7 +342,7 @@ def bucketed_all_gather(shards, axis: str, bucket_bytes=None):
 
 
 def ring_allgather_matmul(compute_chunk: Callable, x_shard, axis: str,
-                          gather_dim: int):
+                          gather_dim: int, *, transport: str = "xla"):
     """All-gather ``x_shard`` chunks along mesh ``axis`` *through* a
     matmul: each arriving ppermute chunk's ``compute_chunk`` issues
     while the next chunk is still in flight.
@@ -317,22 +375,41 @@ def ring_allgather_matmul(compute_chunk: Callable, x_shard, axis: str,
     reverse ring, so the backward gets the same overlapped schedule
     for free. A 1-sized axis degrades to
     ``compute_chunk(x_shard, 0)``.
+
+    ``transport="pallas_dma"`` swaps each hop for the FUSED kernel
+    (:func:`tpu_p2p.parallel.pallas_dma.dma_ship_compute`): the
+    chunk's compute and the next chunk's remote copy live in one
+    Pallas kernel body, so the overlap is the kernel's own schedule
+    rather than XLA's latency-hiding pass — the sub-XLA rung of the
+    same decomposition (docs/pallas_dma.md). Ledger rows become
+    ``kind="dma"``; the recursion structure, chunk order, and the
+    reverse-ring backward are unchanged.
     """
+    _check_transport(transport)
     n = jax.lax.axis_size(axis)
     if n == 1:
         return compute_chunk(x_shard, 0)
     idx = jax.lax.axis_index(axis)
     fwd = [(j, (j + 1) % n) for j in range(n)]
+    PD = _require_pallas_dma() if transport == "pallas_dma" else None
     # n-1 shift-by-1 hops, each carrying the full chunk per link.
-    _record_issue("ppermute", axis, nbytes=_aval_bytes(x_shard),
+    _record_issue("dma" if PD else "ppermute", axis,
+                  nbytes=_aval_bytes(x_shard),
                   axis_size=n, edges=fwd, count=n - 1,
                   label="ring_allgather_matmul")
     cur, src, out = x_shard, idx, None
     for s in range(n):
         # Issue the next hop BEFORE consuming cur: the transfer has no
-        # consumer in this step's matmul, so it overlaps it.
-        nxt = jax.lax.ppermute(cur, axis, fwd) if s + 1 < n else None
-        y = compute_chunk(cur, src)
+        # consumer in this step's matmul, so it overlaps it. Pallas
+        # transport fuses the two into one kernel body instead.
+        if PD is not None and s + 1 < n:
+            nxt, y = PD.dma_ship_compute(
+                cur, axis, fwd,
+                lambda c, sv: compute_chunk(c, sv), cur, src)
+        else:
+            nxt = (jax.lax.ppermute(cur, axis, fwd)
+                   if s + 1 < n and PD is None else None)
+            y = compute_chunk(cur, src)
         if out is None:
             c = y.shape[gather_dim]
             full = list(y.shape)
@@ -549,7 +626,7 @@ def matmul_ring_all_to_all(compute_chunk: Callable, x, axis: str,
 
 def chunked_ppermute_compute(compute_chunk: Callable, x, axis: str,
                              edges: Sequence[Edge], chunk_dim: int,
-                             chunks: int, *,
+                             chunks: int, *, transport: str = "xla",
                              label: str = "chunked_ppermute_compute"):
     """Ship ``compute(x)`` over ``edges`` as a *wave* of chunk hops:
     chunk ``c``'s ``ppermute`` is issued the moment its compute
@@ -581,29 +658,61 @@ def chunked_ppermute_compute(compute_chunk: Callable, x, axis: str,
     is the mirrored reverse-direction wave with the baseline's exact
     gradient structure. ``chunks <= 1`` degrades to the one-shot
     ``ppermute(compute_chunk(x, 0))`` — bitwise the baseline ship.
+
+    ``transport="pallas_dma"`` lowers each ship to a raw async remote
+    copy and FUSES it with the next chunk's compute in one Pallas
+    kernel body (:func:`pallas_dma.dma_ship_compute`): chunk ``c``'s
+    copy is started, chunk ``c+1``'s compute runs between the
+    kernel's start and wait, the final chunk ships via the plain
+    :func:`dma_ppermute`. Same bytes, same chunk order, ledger rows
+    ``kind="dma"`` (docs/pallas_dma.md).
     """
+    _check_transport(transport)
     edges = tuple((int(s), int(d)) for s, d in edges)
     size = x.shape[chunk_dim]
     chunks = max(1, min(int(chunks), max(1, size)))
     if chunks <= 1:
         # One-shot degrade: ledger-recorded through the same wrapper
         # every other model-layer hop uses, so the rows never drift.
-        return ppermute(compute_chunk(x, 0), axis, edges, label=label)
+        ship = dma_ppermute if transport == "pallas_dma" else ppermute
+        return ship(compute_chunk(x, 0), axis, edges, label=label)
     pad = -(-size // chunks) * chunks - size
     if pad:
         widths = [(0, 0)] * x.ndim
         widths[chunk_dim] = (0, pad)
         x = jnp.pad(x, widths)
     ct = (size + pad) // chunks
+
+    def chunk_of(c):
+        return jax.lax.slice_in_dim(x, c * ct, (c + 1) * ct,
+                                    axis=chunk_dim)
+
     arrivals = []
-    for c in range(chunks):
-        xc = jax.lax.slice_in_dim(x, c * ct, (c + 1) * ct, axis=chunk_dim)
-        # Compute chunk c, ship it immediately (via the instrumented
-        # wrapper): the arrival's only consumer is the trailing
-        # concat, so chunk c+1's compute (and the caller's remaining
-        # tick ops) overlap the transfer.
-        arrivals.append(ppermute(compute_chunk(xc, c), axis, edges,
-                                 label=label))
+    if transport == "pallas_dma":
+        PD = _require_pallas_dma()
+        y_prev = compute_chunk(chunk_of(0), 0)
+        # chunks-1 fused ships (each records here; the final plain
+        # ship records through its wrapper below): chunk c's copy is
+        # in flight while chunk c+1's compute runs in the SAME kernel.
+        # Priced by the shipped buffer — the compute OUTPUT, which the
+        # XLA path and the final dma_ppermute also record.
+        _record_issue("dma", axis, nbytes=_aval_bytes(y_prev),
+                      axis_size=jax.lax.axis_size(axis), edges=edges,
+                      count=chunks - 1, label=label)
+        for c in range(1, chunks):
+            arr, y_prev = PD.dma_ship_compute(
+                y_prev, axis, edges,
+                lambda xc, cc=c: compute_chunk(xc, cc), chunk_of(c))
+            arrivals.append(arr)
+        arrivals.append(dma_ppermute(y_prev, axis, edges, label=label))
+    else:
+        for c in range(chunks):
+            # Compute chunk c, ship it immediately (via the
+            # instrumented wrapper): the arrival's only consumer is
+            # the trailing concat, so chunk c+1's compute (and the
+            # caller's remaining tick ops) overlap the transfer.
+            arrivals.append(ppermute(compute_chunk(chunk_of(c), c),
+                                     axis, edges, label=label))
     out = jnp.concatenate(_promote_vma(arrivals), axis=chunk_dim)
     if pad:
         out = jax.lax.slice_in_dim(out, 0, size, axis=chunk_dim)
@@ -642,6 +751,24 @@ def ppermute(x, axis, edges, *, label: str = "ppermute"):
                   edges=tuple((int(s), int(d)) for s, d in edges),
                   label=label)
     return jax.lax.ppermute(x, axis, edges)
+
+
+def dma_ppermute(x, axis, edges, *, label: str = "dma_ppermute"):
+    """Ledger-recorded raw-DMA ppermute — the ``transport="pallas_dma"``
+    twin of :func:`ppermute`: same ``(edges, axis)`` contract, same
+    zeros-for-no-arrival semantics, same reverse-edge transpose, but
+    the hop is a Pallas ``make_async_remote_copy`` kernel
+    (:mod:`tpu_p2p.parallel.pallas_dma`) instead of an XLA
+    CollectivePermute. Rows record as ``kind="dma"`` so the obs report
+    prices the two transports head-to-head. Callers must sit behind
+    ``runtime.pallas_dma_supported()`` (every cache build and the
+    ``--transport`` path does)."""
+    PD = _require_pallas_dma()
+    _record_issue("dma", axis, nbytes=_aval_bytes(x),
+                  axis_size=jax.lax.axis_size(axis),
+                  edges=tuple((int(s), int(d)) for s, d in edges),
+                  label=label)
+    return PD.dma_ppermute(x, axis, edges)
 
 
 def all_to_all(x, axis, split_axis: int, concat_axis: int, *,
@@ -709,18 +836,36 @@ class CollectiveCache:
 
     # -- point-to-point / permutation ------------------------------------
 
-    def permute(self, mesh: Mesh, axis: str, edges: Sequence[Edge]):
+    def permute(self, mesh: Mesh, axis: str, edges: Sequence[Edge],
+                transport: str = "xla"):
         """One ``ppermute`` applying ``edges`` along mesh axis ``axis``.
 
         ``[(src, dst)]`` ≙ the blocking ``ncclSend``/``ncclRecv`` pair of
         ``p2p_matrix.cc:156-171``; ``[(src, dst), (dst, src)]`` ≙ the
         grouped full-duplex exchange of ``p2p_matrix.cc:211-251``.
+
+        ``transport="pallas_dma"``: the same program over one raw
+        async-remote-copy kernel (:func:`dma_ppermute`) — the matrix's
+        sub-XLA backend. The default key is unchanged-in-value
+        (``transport`` rides every key), so ``transport="xla"`` is a
+        bitwise no-op returning the identical cached program.
         """
+        _check_transport(transport)
         edges = _canon_edges(edges, mesh.shape[axis])
-        key = ("permute", mesh, axis, edges)
+        key = ("permute", mesh, axis, edges, transport)
 
         def build():
             spec = P(*mesh.axis_names, None)
+
+            if transport == "pallas_dma":
+                _require_pallas_dma()
+
+                def f(x):
+                    return dma_ppermute(x, axis, edges,
+                                        label="dma_permute")
+
+                return jax.jit(_shard_map_unchecked(
+                    f, mesh, spec, spec))
 
             def f(x):
                 _record_issue("ppermute", axis, nbytes=_aval_bytes(x),
@@ -734,7 +879,8 @@ class CollectiveCache:
 
         return self._get(key, build)
 
-    def permute_chain(self, mesh: Mesh, axis: str, edges: Sequence[Edge], count: int):
+    def permute_chain(self, mesh: Mesh, axis: str, edges: Sequence[Edge],
+                      count: int, transport: str = "xla"):
         """``count`` back-to-back ``ppermute``\\ s compiled as one program.
 
         Each hop's input is the previous hop's output (a real data
@@ -743,13 +889,36 @@ class CollectiveCache:
         serialized mode (one jitted hop per Python iteration, drained
         each time) reproduces the reference's one-message-in-flight
         semantics (``p2p_matrix.cc:154-171``); see SURVEY.md §7 hard
-        part (c) for why both modes exist.
+        part (c) for why both modes exist. ``transport="pallas_dma"``:
+        every hop is the raw-DMA kernel (:meth:`dma_permute_chain` is
+        the named spelling the benchmarks use).
         """
+        _check_transport(transport)
         edges = _canon_edges(edges, mesh.shape[axis])
-        key = ("chain", mesh, axis, edges, count)
+        key = ("chain", mesh, axis, edges, count, transport)
 
         def build():
             spec = P(*mesh.axis_names, None)
+
+            if transport == "pallas_dma":
+                PD = _require_pallas_dma()
+
+                def f(x):
+                    # One record with count=len(scan), like the XLA
+                    # twin: traced once, executed `count` times.
+                    _record_issue("dma", axis, nbytes=_aval_bytes(x),
+                                  axis_size=mesh.shape[axis],
+                                  edges=edges, count=count,
+                                  label="dma_permute_chain")
+
+                    def step(carry, _):
+                        return PD.dma_ppermute(carry, axis, edges), None
+
+                    out, _ = jax.lax.scan(step, x, None, length=count)
+                    return out
+
+                return jax.jit(_shard_map_unchecked(
+                    f, mesh, spec, spec))
 
             def f(x):
                 # Recorded once with count=len(scan): the scan body is
@@ -769,6 +938,17 @@ class CollectiveCache:
             )
 
         return self._get(key, build)
+
+    def dma_permute_chain(self, mesh: Mesh, axis: str,
+                          edges: Sequence[Edge], count: int):
+        """``count`` chained raw-DMA hops in one program — the
+        ``transport="pallas_dma"`` twin of :meth:`permute_chain` under
+        its benchmark name: the fused/differential unit of the
+        Pallas-transport p2p matrix and the ``ring_gbps_pallas`` /
+        ``p2p_lat_us_pallas`` bench headlines, directly comparable to
+        the XLA chain on the same ``(mesh, edges, count)`` key."""
+        return self.permute_chain(mesh, axis, edges, count,
+                                  transport="pallas_dma")
 
     def loopback_chain(self, mesh: Mesh, count: int, trailing: int = 1):
         """``count`` chained whole-buffer rewrites on each device.

@@ -1,0 +1,417 @@
+"""Sub-XLA transport: raw async remote copies as Pallas kernels.
+
+The XLA ``ppermute`` transport pays a fixed program-dispatch floor
+(~0.55 µs one-op span, BENCH_r05 ``latency_8b_oneop_p50_us``) that
+hides the true ICI latency the p2p matrix is supposed to expose. This
+module is the rung below: ``pltpu.make_async_remote_copy`` with
+explicit send/recv DMA semaphores inside a ``pallas_call`` — the
+reference's ``ncclSend``/``ncclRecv`` re-emitted as the TPU's actual
+RDMA primitive instead of an XLA collective (SNIPPETS.md [1]/[2]), and
+the decomposition-overlap lever of Wang et al. (ASPLOS 2023) pushed
+below XLA's async scheduler: :func:`dma_ship_compute` puts the chunk
+compute and the next chunk's DMA in ONE kernel body, so the overlap is
+the kernel's own instruction schedule, not a scheduler heuristic.
+
+Two primitives, both shard_map-traceable (call them inside a
+``jax.shard_map`` over the mesh axis, like ``jax.lax.ppermute``):
+
+- :func:`dma_ppermute` — apply an arbitrary ordered-edge list with the
+  exact ``jax.lax.ppermute`` contract (unique sources, unique
+  destinations, rows with no incoming edge become zeros).
+- :func:`dma_ship_compute` — start the remote copy of one buffer over
+  the edge set, trace an arbitrary compute INTO the same kernel body
+  while the DMA is in flight, then wait: the fused per-hop unit of the
+  shift-by-1 rings (``collectives.ring_allgather_matmul``) and the
+  chunk waves (``collectives.chunked_ppermute_compute``).
+
+Edge sets and the permutation completion
+----------------------------------------
+``make_async_remote_copy`` is a *push*: the sender addresses the
+receiver's buffer and the receiver's DMA semaphore. A partial edge set
+(the single ``(src, dst)`` pair of the p2p matrix) would leave some
+devices sending nothing and some receiving nothing — but semaphore
+accounting must balance per device, and the interpret-mode discharge
+executes the copy collectively. So the edge set is completed to a full
+permutation: devices without an outgoing real edge are paired with
+devices without an incoming one (sorted order, deterministic), every
+device sends exactly one message and receives exactly one, and rows
+whose only arrival is a dummy are zeroed after the kernel — XLA
+CollectivePermute semantics, bit for bit. The dummy edges move bytes a
+real NCCL send would not; callers measuring a partial edge set get the
+honest picture from the ledger, which records the REAL edges only.
+
+Semaphore protocol (one hop)
+----------------------------
+1. (real TPU only) barrier: signal the device that sends to me on the
+   global barrier semaphore ("my receive buffer exists"), wait for one
+   signal from the device I send to. Without it a fast sender can DMA
+   into a neighbor whose kernel has not started — the classic remote
+   DMA race (docs/pallas_dma.md).
+2. ``make_async_remote_copy(src_ref → dst_ref@dst, send_sem,
+   recv_sem).start()`` — the RDMA is in flight.
+3. (fused variant) compute runs here, inside the same kernel body.
+4. ``.wait()`` — blocks on ``recv_sem`` until the incoming copy landed
+   (and ``send_sem`` until our buffer is reusable).
+
+Interpret mode (the tier-1 CPU path)
+------------------------------------
+On platforms without a TPU the kernels run under ``interpret=True``:
+jax discharges the DMA into collective gathers, so semantics (and the
+parity tests) are exact while the timing is meaningless — the
+capability probe (``runtime.pallas_dma_supported``) gates every
+caller, and bench stamps interpret-sourced numbers. Two version traps
+the probe absorbs: ``device_id`` must be a SCALAR with
+``DeviceIdType.LOGICAL`` (the tuple/MESH form trips the 0.4.x
+discharge rule), and traced values closed over by the fused compute
+must be hoisted to kernel inputs (``jax.closure_convert`` hoists
+inexact dtypes; traced INTEGERS must be passed explicitly — see
+:func:`dma_ship_compute`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Edge = Tuple[int, int]
+
+# Compiler-params class moved names across jax versions; the barrier
+# path (real TPU only) needs collective_id, interpret mode needs
+# neither.
+_CompilerParams = (getattr(pltpu, "CompilerParams", None)
+                   or getattr(pltpu, "TPUCompilerParams", None))
+
+
+def interpret_default() -> bool:
+    """True when the backend has no Mosaic lowering (everything but
+    real TPU) — the per-call default for ``interpret=``."""
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return True
+
+
+def complete_permutation(edges: Sequence[Edge], n: int):
+    """Complete a partial permutation to a total one.
+
+    → ``(dst_table, src_table, has_in)`` as numpy arrays of length
+    ``n``: ``dst_table[r]`` is where rank ``r``'s push lands (a dummy
+    target for ranks with no real outgoing edge), ``src_table[r]`` is
+    who pushes into rank ``r`` (the barrier peer), and ``has_in[r]``
+    says whether the arrival is a REAL edge (False → the row zeroes,
+    XLA ppermute semantics). Unmatched senders pair with unmatched
+    receivers in sorted order, so the completion is deterministic and
+    the kernel is one total permutation — every device sends exactly
+    one message and receives exactly one, which is what balances the
+    send/recv semaphores.
+    """
+    edges = tuple((int(s), int(d)) for s, d in edges)
+    dsts = [d for _, d in edges]
+    srcs = [s for s, _ in edges]
+    if len(set(dsts)) != len(dsts) or len(set(srcs)) != len(srcs):
+        raise ValueError(f"edge set {edges} is not a partial "
+                         "permutation (duplicate source or destination)")
+    for s, d in edges:
+        if not (0 <= s < n and 0 <= d < n):
+            raise ValueError(f"edge ({s}, {d}) out of range for axis "
+                             f"of size {n}")
+    dst_table = np.full(n, -1, np.int32)
+    has_in = np.zeros(n, bool)
+    for s, d in edges:
+        dst_table[s] = d
+        has_in[d] = True
+    free_dst = [r for r in range(n) if not has_in[r]]
+    free_src = [r for r in range(n) if dst_table[r] < 0]
+    for s, d in zip(free_src, free_dst):
+        dst_table[s] = d
+    src_table = np.empty(n, np.int32)
+    src_table[dst_table] = np.arange(n, dtype=np.int32)
+    return dst_table, src_table, has_in
+
+
+def _as_2d(x):
+    """Pallas TPU refs want >= 2D, lane-minor buffers; interpret mode
+    does not care. One shared shim: flatten to ``(1, size)`` and
+    restore after — byte identity, no relayout on the interpret path.
+    """
+    return x.reshape(1, -1) if x.ndim < 2 else x.reshape(x.shape[0], -1)
+
+
+def _dma_transport_permute_call(x, dst_id, src_id, *, interpret: bool,
+                                collective_id: int = 0):
+    """One total-permutation push: DMA ``x`` to rank ``dst_id``'s
+    output buffer, receive the symmetric push, return the arrival.
+
+    ``dst_id`` / ``src_id``: traced int32 scalars (this rank's row of
+    the completed tables), reshaped to the SMEM ``(1, 1)`` scalar
+    convention.
+
+    Named ``dma_transport_*`` like its kernel body: this framework's
+    Pallas kernels land on the device track under their jitted Python
+    names (``profiling.OP_CATEGORY_RULES`` — e.g. ``_flash_bwd_call``,
+    validated on the v5e), so BOTH the wrapper and the kernel carry
+    the substring the obs ledger keys ``kind="dma"`` on — whichever
+    name a given runtime emits, the join and the roofline attribution
+    see a dma hop.
+    """
+    shape = x.shape
+    x2 = _as_2d(x)
+
+    def dma_transport_ppermute(dst_ref, src_ref, in_ref, out_ref,
+                               send_sem, recv_sem):
+        if not interpret:
+            # Real TPU: the sender must not push before the receiver's
+            # kernel (and out_ref) exists. I signal the rank whose DMA
+            # targets me; my own target signals me.
+            barrier = pltpu.get_barrier_semaphore()
+            pltpu.semaphore_signal(
+                barrier, inc=1, device_id=src_ref[0, 0],
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            pltpu.semaphore_wait(barrier, 1)
+        op = pltpu.make_async_remote_copy(
+            src_ref=in_ref,
+            dst_ref=out_ref,
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id=dst_ref[0, 0],
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        op.start()
+        op.wait()
+
+    kwargs = {}
+    if not interpret and _CompilerParams is not None:
+        kwargs["compiler_params"] = _CompilerParams(
+            collective_id=collective_id)
+    out = pl.pallas_call(
+        dma_transport_ppermute,
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * 2,
+        interpret=interpret,
+        **kwargs,
+    )(jnp.reshape(dst_id, (1, 1)), jnp.reshape(src_id, (1, 1)), x2)
+    return out.reshape(shape)
+
+
+def _tables_for(axis: str, edges: Sequence[Edge]):
+    """→ (n, traced dst/src scalars, keep flag) for this rank."""
+    n = jax.lax.axis_size(axis)
+    dst_t, src_t, has_in = complete_permutation(edges, n)
+    idx = jax.lax.axis_index(axis)
+    dst = jnp.asarray(dst_t, jnp.int32)[idx]
+    src = jnp.asarray(src_t, jnp.int32)[idx]
+    keep = jnp.asarray(has_in)[idx]
+    return n, dst, src, keep
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _dma_ppermute(x, axis, edges, interpret):
+    n, dst, src, keep = _tables_for(axis, edges)
+    if n == 1 and not edges:
+        return jnp.zeros_like(x)
+    out = _dma_transport_permute_call(x, dst, src,
+                                      interpret=interpret)
+    return jnp.where(keep, out, jnp.zeros_like(out))
+
+
+def _dma_ppermute_fwd(x, axis, edges, interpret):
+    return _dma_ppermute(x, axis, edges, interpret), None
+
+
+def _dma_ppermute_bwd(axis, edges, interpret, _res, g):
+    # The transpose of a permutation is the reverse-edge permutation —
+    # no cross-rank summing (the PR-2 probe's rule), so the backward
+    # is the same sub-XLA hop in the opposite direction.
+    rev = tuple((d, s) for s, d in edges)
+    return (_dma_ppermute(g, axis, rev, interpret),)
+
+
+_dma_ppermute.defvjp(_dma_ppermute_fwd, _dma_ppermute_bwd)
+
+
+def dma_ppermute(x, axis: str, edges: Sequence[Edge], *,
+                 interpret: bool = None):
+    """``jax.lax.ppermute(x, axis, edges)`` over raw async remote
+    copies — same contract, same zeros-for-no-arrival semantics, same
+    reverse-edge transpose, one Pallas kernel instead of an XLA
+    CollectivePermute. Uninstrumented: the ledger-recorded wrapper is
+    ``collectives.dma_ppermute``.
+    """
+    if interpret is None:
+        interpret = interpret_default()
+    return _dma_ppermute(x, axis, tuple((int(s), int(d))
+                                        for s, d in edges), bool(interpret))
+
+
+# ------------------------------------------------- fused ship+compute
+
+
+def _scalar_specs(operands):
+    """Kernel plumbing for mixed operands: scalars ride SMEM ``(1,1)``
+    (the TPU scalar convention), arrays ride ANY. → (kernel inputs,
+    specs, readers)."""
+    kern_ops, specs, readers = [], [], []
+    for v in operands:
+        v = jnp.asarray(v)
+        if v.ndim == 0:
+            kern_ops.append(jnp.reshape(v, (1, 1)))
+            specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+            readers.append(lambda r: r[0, 0])
+        else:
+            kern_ops.append(v)
+            specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+            readers.append(lambda r: r[...])
+    return kern_ops, specs, readers
+
+
+def _dma_transport_ship_call(axis, edges, interpret, fn, out_aval,
+                             ship, ops):
+    # dma_transport_* like the kernel body — see
+    # _dma_transport_permute_call on why both names carry the prefix.
+    n, dst, src, keep = _tables_for(axis, edges)
+    shape = ship.shape
+    s2 = _as_2d(ship)
+    kern_ops, specs, readers = _scalar_specs(ops)
+
+    def dma_transport_ship_compute(dst_ref, src_ref, ship_ref, *rest):
+        op_refs = rest[:len(kern_ops)]
+        arr_ref, y_ref, send_sem, recv_sem = rest[len(kern_ops):]
+        if not interpret:
+            barrier = pltpu.get_barrier_semaphore()
+            pltpu.semaphore_signal(
+                barrier, inc=1, device_id=src_ref[0, 0],
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            pltpu.semaphore_wait(barrier, 1)
+        op = pltpu.make_async_remote_copy(
+            src_ref=ship_ref,
+            dst_ref=arr_ref,
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id=dst_ref[0, 0],
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        op.start()
+        # The fusion point: the per-chunk compute issues HERE, between
+        # start and wait, so the kernel's own schedule rides the
+        # arithmetic under the in-flight DMA — no XLA scheduler in the
+        # loop (the sub-XLA half of the Wang et al. decomposition).
+        y_ref[...] = fn(*[rd(r) for rd, r in zip(readers, op_refs)])
+        op.wait()
+
+    kwargs = {}
+    if not interpret and _CompilerParams is not None:
+        kwargs["compiler_params"] = _CompilerParams(collective_id=1)
+    arrived, y = pl.pallas_call(
+        dma_transport_ship_compute,
+        out_shape=(jax.ShapeDtypeStruct(s2.shape, s2.dtype),
+                   jax.ShapeDtypeStruct(out_aval.shape, out_aval.dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.ANY)] + specs,
+        out_specs=(pl.BlockSpec(memory_space=pltpu.ANY),
+                   pl.BlockSpec(memory_space=pltpu.ANY)),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * 2,
+        interpret=interpret,
+        **kwargs,
+    )(jnp.reshape(dst, (1, 1)), jnp.reshape(src, (1, 1)), s2, *kern_ops)
+    arrived = arrived.reshape(shape)
+    arrived = jnp.where(keep, arrived, jnp.zeros_like(arrived))
+    return arrived, y
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _ship_compute_vjp(axis, edges, interpret, fn, out_aval, ship, *ops):
+    return _dma_transport_ship_call(axis, edges, interpret, fn,
+                                    out_aval, ship, ops)
+
+
+def _ship_compute_fwd(axis, edges, interpret, fn, out_aval, ship, *ops):
+    out = _dma_transport_ship_call(axis, edges, interpret, fn,
+                                   out_aval, ship, ops)
+    return out, ops
+
+
+def _ship_compute_bwd(axis, edges, interpret, fn, out_aval, ops, g):
+    g_arr, g_y = g
+    # Ship cotangent: reverse-edge permute, same sub-XLA transport —
+    # the mirrored backward hop of the XLA rings. Compute cotangents:
+    # the plain vjp of the (closure-converted, hence closure-free)
+    # compute — the backward matmul runs as ordinary XLA, which is
+    # where it already lived for the XLA-transport rings.
+    rev = tuple((d, s) for s, d in edges)
+    d_ship = _dma_ppermute(g_arr, axis, rev, interpret)
+    _, pull = jax.vjp(fn, *ops)
+    return (d_ship, *pull(g_y))
+
+
+_ship_compute_vjp.defvjp(_ship_compute_fwd, _ship_compute_bwd)
+
+
+def dma_ship_compute(ship, axis: str, edges: Sequence[Edge],
+                     compute_fn: Callable, *operands,
+                     interpret: bool = None):
+    """Start the remote copy of ``ship`` over ``edges``, run
+    ``compute_fn(*operands)`` INSIDE the same kernel body while the
+    DMA is in flight, wait, and return ``(arrived, y)``.
+
+    The fused per-hop unit of the decomposition rings: one kernel owns
+    both the transfer and the arithmetic, so the overlap is the
+    kernel's instruction schedule (DMA engines run asynchronously to
+    the MXU/VPU), not an XLA latency-hiding heuristic.
+
+    ``compute_fn`` is closure-converted: traced FLOAT values it closes
+    over (weight shards) are hoisted to kernel inputs by
+    ``jax.closure_convert``, and anything that survives as a jaxpr
+    CONSTANT — concrete arrays the compute closes over, traced ints
+    (ring indices) on jax versions whose closure_convert hoists
+    inexact dtypes only — is lifted to a kernel operand here too,
+    because ``pallas_call`` rejects a kernel that "captures
+    constants". Passing traced ints via ``operands`` explicitly stays
+    supported (and is what the in-repo rings do). Scalar operands ride
+    SMEM, arrays ride ANY. Differentiable: the ship's cotangent is the
+    reverse-edge :func:`dma_ppermute`; the compute's is its ordinary
+    vjp.
+    """
+    if interpret is None:
+        interpret = interpret_default()
+    edges = tuple((int(s), int(d)) for s, d in edges)
+    operands = tuple(jnp.asarray(v) for v in operands)
+    fn, hoisted = jax.closure_convert(compute_fn, *operands)
+    hoisted = tuple(hoisted)
+    out_aval = jax.eval_shape(compute_fn, *operands)
+    # Lift leftover jaxpr constants (closure_convert hoists only
+    # closed-over tracers of inexact dtype) to operands: without this
+    # a compute that closes over a concrete weight crashes kernel
+    # tracing with "captures constants" under the pallas transport
+    # while the XLA transport accepts it.
+    consts = ()
+    try:
+        closed = jax.make_jaxpr(fn)(*operands, *hoisted)
+        consts = tuple(closed.consts)
+    except Exception:  # pragma: no cover - make_jaxpr surface drift
+        pass
+    if consts:
+        jaxpr, n_c, n_args = closed.jaxpr, len(consts), len(operands)
+
+        def fn(*args):  # noqa: F811 — deliberate shadow
+            out = jax.core.eval_jaxpr(jaxpr, args[n_args:n_args + n_c],
+                                      *args[:n_args], *args[n_args + n_c:])
+            return out[0] if len(out) == 1 else tuple(out)
+
+        hoisted = (*(jnp.asarray(c) for c in consts), *hoisted)
+    return _ship_compute_vjp(axis, edges, bool(interpret), fn,
+                             out_aval, ship, *operands, *hoisted)

@@ -108,6 +108,107 @@ class Runtime:
             jax.device_put(np.int32(0), d).block_until_ready()
 
 
+# --------------------------------------------- pallas_dma capability
+# One probe per process (SURVEY.md-style fail-fast, cached): the
+# sub-XLA transport (tpu_p2p/parallel/pallas_dma.py) depends on
+# version-sensitive Pallas surfaces — interpret-mode discharge of
+# make_async_remote_copy on CPU, Mosaic lowering + barrier semaphores
+# on TPU — so every caller (CollectiveCache pallas builds, the
+# --transport CLI path, bench's DMA metrics, obs live_capture) gates
+# on ONE tiny end-to-end parity run instead of N scattered try/
+# excepts. Failure is remembered with its reason so bench/stderr can
+# say WHY the DMA_NULL schema published.
+
+_PALLAS_DMA_OK: Optional[bool] = None
+_PALLAS_DMA_ERR: Optional[str] = None
+
+
+def _can_probe_here() -> bool:
+    """Can the eager capability probe run in the CURRENT context?
+
+    The probe jits its own 2-device program and pulls the result back
+    to numpy — inside an outer trace (the primitives call
+    ``_require_pallas_dma`` at trace time, e.g. ``ring_allgather_
+    matmul(transport="pallas_dma")`` under ``shard_map``) the inner
+    jit inlines, ``np.asarray`` hits a tracer, and the probe would
+    cache a PERMANENT spurious False. Detect that context: the cheap
+    version check first, then a control — if a plain jitted identity
+    cannot round-trip to numpy either, a probe failure says nothing
+    about the backend.
+    """
+    try:
+        if not jax.core.trace_state_clean():
+            return False
+    except Exception:  # jax.core surface drift — fall through
+        pass
+    try:
+        return int(np.asarray(jax.jit(lambda v: v + 1)(np.int32(1)))) == 2
+    except Exception:
+        return False
+
+
+def pallas_dma_supported(refresh: bool = False) -> bool:
+    """Does ``transport="pallas_dma"`` work on this backend?
+
+    Runs one shift-by-1 ``dma_ppermute`` on a tiny mesh (2 devices
+    when available, the 1-device self-edge otherwise) and compares
+    against the host permutation. Any failure — missing API, interpret
+    discharge drift, Mosaic rejection — caches False plus the reason
+    (:func:`pallas_dma_probe_error`); success caches True. The probe
+    costs one small compile, once per process.
+
+    Called mid-trace before any eager probe ran, this FAILS OPEN
+    without caching (returns the cached verdict if one exists): the
+    probe cannot execute there, an unsupported backend still errors
+    loudly when the kernel itself builds, and the next eager call
+    probes for real.
+    """
+    global _PALLAS_DMA_OK, _PALLAS_DMA_ERR
+    if _PALLAS_DMA_OK is not None and not refresh:
+        return _PALLAS_DMA_OK
+    if not _can_probe_here():
+        return True if _PALLAS_DMA_OK is None else _PALLAS_DMA_OK
+    try:
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_p2p.parallel import pallas_dma as PD
+        from tpu_p2p.parallel.collectives import _shard_map_unchecked
+
+        devs = jax.devices()
+        n = min(2, len(devs))
+        mesh = Mesh(np.array(devs[:n]), (MESH_AXIS,))
+        edges = tuple((i, (i + 1) % n) for i in range(n))
+        spec = P(MESH_AXIS, None)
+        # Built exactly like the production programs (replication
+        # checking off): a checked shard_map can reject vma-less
+        # Pallas outputs and would falsely disable a working backend.
+        fn = jax.jit(_shard_map_unchecked(
+            lambda x: PD.dma_ppermute(x, MESH_AXIS, edges),
+            mesh, spec, spec,
+        ))
+        x = np.arange(n * 8, dtype=np.int32).reshape(n, 8)
+        got = np.asarray(fn(jnp.asarray(x)))
+        want = np.zeros_like(x)
+        for s, d in edges:
+            want[d] = x[s]
+        if not np.array_equal(got, want):
+            raise RuntimeError(
+                f"probe permutation mismatch: got {got.tolist()} "
+                f"want {want.tolist()}"
+            )
+        _PALLAS_DMA_OK, _PALLAS_DMA_ERR = True, None
+    except Exception as e:  # noqa: BLE001 — the probe IS the gate
+        _PALLAS_DMA_OK = False
+        _PALLAS_DMA_ERR = f"{type(e).__name__}: {e}"
+    return _PALLAS_DMA_OK
+
+
+def pallas_dma_probe_error() -> Optional[str]:
+    """The cached probe failure reason (None when untested or OK)."""
+    return _PALLAS_DMA_ERR
+
+
 def make_hybrid_runtime(num_devices: Optional[int] = None,
                         devices=None) -> Runtime:
     """A 2-axis ``('dcn', 'd')`` mesh over a multi-slice TPU job.

@@ -166,15 +166,21 @@ def device_op_events(trace_dir: str) -> List[DeviceEvent]:
 # matched LAST among compute ops and callers should read it as "fused
 # compute (matmul and/or elementwise)".
 OP_CATEGORY_RULES = (
+    # ``dma_transport`` = the round-11 Pallas raw-DMA permute kernels
+    # (tpu_p2p/parallel/pallas_dma.py — every kernel there carries the
+    # prefix precisely so its device events classify as TRANSPORT, not
+    # "kernel"): they move bytes across the mesh, so the obs join and
+    # the overlap fractions must see them next to collective-permute.
     ("collective", ("all-reduce", "all-gather", "all-to-all",
                     "collective-permute", "reduce-scatter",
-                    "collective")),
+                    "dma_transport", "collective")),
     # This framework's Pallas kernels appear on the device track under
     # their jitted Python names (e.g. ``_flash_bwd_call.188``), not as
     # ``custom-call`` — checked BEFORE the copy rules so
-    # ``_cache_row_write`` is a kernel, not a "write" false-positive.
+    # ``cache_row_write`` (tpu_p2p/ops/kvcache.py) is a kernel, not a
+    # "write" false-positive.
     ("kernel", ("custom-call", "_flash_call", "_flash_bwd_call",
-                "_dq_reduce", "_cache_row_write")),
+                "_dq_reduce", "cache_row_write")),
     ("copy", ("copy", "bitcast", "transpose", "slice", "concatenate",
               "dynamic-update-slice", "dynamic-slice", "pad", "gather",
               "scatter", "reshape", "broadcast")),

@@ -200,8 +200,11 @@ def load_done_cells(path: Optional[str]) -> dict:
                 d = json.loads(line)
                 if d.get("timed_out"):
                     continue  # re-measure wedged cells on resume
+                # Transport joined the key in round 11; records from
+                # earlier rounds carry none and were all XLA-measured.
                 key = (d["workload"], d["direction"], d["src"], d["dst"],
-                       d["msg_bytes"], d["mode"])
+                       d["msg_bytes"], d["mode"],
+                       d.get("transport", "xla"))
                 done[key] = d.get("gbps", math.nan)
             except (json.JSONDecodeError, KeyError):
                 continue  # torn write from an interrupted run

@@ -24,6 +24,15 @@ from tpu_p2p.utils.report import CellRecord, JsonlWriter
 
 WORKLOADS: Dict[str, Callable] = {}
 
+# The workloads whose measured programs select cfg.transport (their
+# edges compile through CollectiveCache.permute/permute_chain, which
+# take the knob) — loopback counts via its intra-host PAIR; its
+# self-edge floor is excluded by the src != dst guard at the stamp
+# site. Everything else — the collective patterns, the model-step
+# patterns — runs the same programs under either flag.
+TRANSPORT_WORKLOADS = frozenset({"pairwise", "latency", "loopback",
+                                 "ring", "torus2d"})
+
 
 def workload(name: str):
     def deco(fn):
@@ -105,13 +114,20 @@ def measure_edges(
     ``bytes_per_device`` overrides the numerator for collective patterns
     where each device moves a different byte count than ``msg_bytes``
     (e.g. all_to_all moves ``msg*(n-1)/n``).
+
+    The programs honor ``cfg.transport``: "xla" compiles the
+    CollectivePermute programs (bitwise the pre-round-11 behavior),
+    "pallas_dma" the raw async-remote-copy kernels — the same edge
+    set, payload, and timing machinery over the sub-XLA backend.
     """
     x = ctx.payloads.get(mesh, msg_bytes, np.dtype(ctx.cfg.dtype))
     nbytes = bytes_per_device if bytes_per_device is not None else msg_bytes
+    transport = ctx.cfg.transport
     return measure_collective(
         ctx,
-        ctx.cache.permute(mesh, axis, edges),
-        lambda k: ctx.cache.permute_chain(mesh, axis, edges, k),
+        ctx.cache.permute(mesh, axis, edges, transport=transport),
+        lambda k: ctx.cache.permute_chain(mesh, axis, edges, k,
+                                          transport=transport),
         x,
         bytes_per_device=nbytes,
         directions=directions,
@@ -176,7 +192,10 @@ def verify_edges(ctx: WorkloadContext, mesh, axis: str, edges, msg_bytes: int) -
     """
     dtype = np.dtype(ctx.cfg.dtype)
     x = ctx.payloads.get(mesh, msg_bytes, dtype)
-    fn = ctx.cache.permute(mesh, axis, edges)
+    # Same transport as the measurement: --check on a pallas_dma run
+    # verifies the DMA kernel's actual arrivals, not the XLA twin's.
+    fn = ctx.cache.permute(mesh, axis, edges,
+                           transport=ctx.cfg.transport)
     got = fn(x)
     axis_dim = list(mesh.axis_names).index(axis)
     # Oracle reconstructed host-side (deterministic payload), compared
@@ -210,6 +229,15 @@ def cell_record(
     source = getattr(samples, "source", None)
     if source is not None:
         extra = {**extra, "source": source}
+    # Which permute backend measured the cell — part of the resume key
+    # (report.load_done_cells), so an xla JSONL never satisfies a
+    # pallas_dma rerun of the same cell (and vice versa). Stamped ONLY
+    # on the permute-family workloads that honor cfg.transport: the
+    # collective patterns (allreduce &c) and the self-edge loopback
+    # floor run identical XLA programs under either flag, and stamping
+    # those would attribute XLA-measured cells to the pallas backend.
+    if workload in TRANSPORT_WORKLOADS and src != dst:
+        extra.setdefault("transport", ctx.cfg.transport)
     return CellRecord(
         workload=workload,
         direction=direction,

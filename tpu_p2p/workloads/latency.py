@@ -32,7 +32,8 @@ def _measure_pair_latency(ctx: WorkloadContext, src: int, dst: int, nbytes: int)
     if src == dst:
         # A self-edge ppermute is an identity XLA deletes outright
         # (collectives.loopback_chain docstring); measure the honest
-        # dispatch+full-buffer-rewrite floor instead.
+        # dispatch+full-buffer-rewrite floor instead. No permute is
+        # issued, so the transport knob has nothing to select here.
         fn = ctx.cache.loopback_chain(mesh, 1)
         chain = ctx.cache.loopback_chain(mesh, cfg.iters)
     else:
@@ -40,10 +41,15 @@ def _measure_pair_latency(ctx: WorkloadContext, src: int, dst: int, nbytes: int)
         if cfg.isolation == "submesh":
             mesh = rt.submesh([src, dst])
             edges = ((0, 1),)
-        fn = ctx.cache.permute(mesh, axis, edges)
+        # The latency floor is exactly what --transport exists for:
+        # the XLA one-op span carries the ~0.55 µs dispatch floor the
+        # raw-DMA kernel strips (docs/pallas_dma.md).
+        fn = ctx.cache.permute(mesh, axis, edges,
+                               transport=cfg.transport)
         # Fused chain: iters data-dependent hops in one program — the
         # dispatch-free device-side hop time (SURVEY.md §7(e)).
-        chain = ctx.cache.permute_chain(mesh, axis, edges, cfg.iters)
+        chain = ctx.cache.permute_chain(mesh, axis, edges, cfg.iters,
+                                        transport=cfg.transport)
     x = ctx.payloads.get(mesh, nbytes, ctx.cfg.dtype)
     ser = timing.measure_serialized(
         fn, x, cfg.iters, warmup=max(1, cfg.warmup), timeout_s=cfg.timeout_s,
@@ -60,7 +66,7 @@ def _measure_pair_latency(ctx: WorkloadContext, src: int, dst: int, nbytes: int)
             chain_of = lambda k: ctx.cache.loopback_chain(mesh, k)  # noqa: E731
         else:
             chain_of = lambda k: ctx.cache.permute_chain(  # noqa: E731
-                mesh, axis, edges, k
+                mesh, axis, edges, k, transport=cfg.transport
             )
         fused = measure_headline(
             chain_of, x, cfg.iters, repeats=cfg.fused_repeats,
@@ -81,9 +87,14 @@ def run_latency(ctx: WorkloadContext) -> dict:
     src, dst = (0, 1) if n > 1 else (0, 0)
     nbytes = ctx.cfg.msg_size if ctx.cfg.msg_size is not None else LATENCY_BYTES
     ser, fused = _measure_pair_latency(ctx, src, dst, nbytes)
+    # The self-edge (1-device) path measures the loopback floor and
+    # never selects a transport — claiming "via pallas_dma" there
+    # would stamp the XLA loopback number with DMA provenance.
+    via = ("" if ctx.cfg.transport == "xla" or src == dst
+           else f" via {ctx.cfg.transport}")
     if ctx.is_printer:
         sys.stdout.write(
-            f"latency {format_size(nbytes)} {src}->{dst}: "
+            f"latency {format_size(nbytes)}{via} {src}->{dst}: "
             f"p50 {ser.p50 * 1e6:.2f}us  p99 {ser.p99 * 1e6:.2f}us  "
             f"min {ser.min * 1e6:.2f}us (serialized, dispatch-inclusive); "
             f"per-hop {fused.mean * 1e6:.2f}us "

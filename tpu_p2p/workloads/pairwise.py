@@ -45,9 +45,13 @@ def _pair_edges(direction: str, src: int, dst: int):
 def _run_matrix(ctx: WorkloadContext, direction: str, msg_bytes: int) -> dict:
     rt, cfg = ctx.rt, ctx.cfg
     n = rt.num_devices
+    # The non-default transport announces itself in the section title
+    # (the golden pin's contract); the default keeps the reference's
+    # exact byte layout.
+    via = "" if cfg.transport == "xla" else f" via {cfg.transport}"
     title = (
         f"Evaluating the {'Uni' if direction == 'uni' else 'Bi'}-Directional "
-        f"TPU P2P Bandwidth (Gbps)"
+        f"TPU P2P Bandwidth{via} (Gbps)"
     )
     stream = sys.stdout if ctx.is_printer else None
     rep = MatrixReporter(n, title, stream if stream else _NullStream())
@@ -61,7 +65,8 @@ def _run_matrix(ctx: WorkloadContext, direction: str, msg_bytes: int) -> dict:
             if dst == n - 1:
                 rep.end_row()
             continue
-        key = ("pairwise", direction, src, dst, msg_bytes, cfg.mode)
+        key = ("pairwise", direction, src, dst, msg_bytes, cfg.mode,
+               cfg.transport)
         prev = ctx.previously_done(key)
         if prev is not None:
             rep.cell(src, dst, prev)
@@ -96,6 +101,7 @@ def _run_matrix(ctx: WorkloadContext, direction: str, msg_bytes: int) -> dict:
             rep.end_row()
     summary = rep.print_summary(
         f"pairwise {direction}-dir {format_size(msg_bytes)} {cfg.mode}"
+        + ("" if cfg.transport == "xla" else f" {cfg.transport}")
     )
     return {"direction": direction, "msg_bytes": msg_bytes, **summary}
 
