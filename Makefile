@@ -17,7 +17,7 @@ SHELL    := /bin/bash
 
 NATIVE_SO := native/libtpu_p2p_native.so
 
-.PHONY: all native run test tier1 bench obs clean
+.PHONY: all native run test tier1 bench obs health clean
 
 all: native
 
@@ -51,6 +51,16 @@ bench: native
 # gate — nonzero exit on regression, so CI can gate on it.
 obs:
 	$(PYTHON) -m tpu_p2p obs $(ARGS)
+
+# Injected-fault health smoke (docs/health.md): degraded link,
+# straggler rank, and lost host + self-healing resume, each detected
+# by tpu_p2p/obs/health.py on a deterministic fault plan — nonzero
+# exit unless every detector fires within the gate's detect-steps
+# budget and the heal's loss parity holds. Defaults to the simulated
+# 8-device CPU mesh so it runs anywhere; override with ARGS= (e.g. an
+# empty ARGS="--steps 12" on real hardware).
+health:
+	$(PYTHON) -m tpu_p2p obs smoke $(if $(ARGS),$(ARGS),--cpu-mesh 8)
 
 # `make train ARGS="--steps 100 --ckpt-dir runs/a"` — the training
 # loop (tpu_p2p/train.py): loader + step + checkpoint/resume + JSONL.

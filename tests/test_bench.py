@@ -304,11 +304,21 @@ def test_main_multichip_branch_schema(capsys, monkeypatch, tmp_path):
         bench, "_pp_overlap_metrics",
         lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
     )
+    # The health smoke runs two full instrumented train loops —
+    # real coverage lives in tests/test_obs_health.py; here exercise
+    # the failure wiring (explicit nulls, schema intact).
+    monkeypatch.setattr(
+        bench, "_health_metrics",
+        lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
+    )
     compact, r = _run_main(capsys, monkeypatch, tmp_path)
     assert compact["metric"] == r["metric"]
     assert compact["value"] == r["value"]
     assert compact["n"] == 8
-    assert compact["headline"]["pairs_measured"] == 3
+    # pairs_measured left the compact headline in round 12 (the
+    # health trio took its bytes); the detail file still carries it.
+    assert "pairs_measured" not in compact["headline"]
+    assert r["detail"]["pairs_measured"] == 3
     assert r["metric"] == "all_pairs_unidir_bandwidth_avg"
     # Stubbed-failure FSDP/tp-overlap metrics degrade to explicit nulls.
     assert r["detail"]["fsdp_overlap_frac"] is None
@@ -319,6 +329,9 @@ def test_main_multichip_branch_schema(capsys, monkeypatch, tmp_path):
     assert r["detail"]["pp_step_ms_overlap_wave"] is None
     assert r["detail"]["ring_achieved_gbps"] is None
     assert r["detail"]["obs_step_ms_p50"] is None
+    assert r["detail"]["health_detect_steps"] is None
+    assert r["detail"]["heal_resume_loss_delta"] is None
+    assert "RuntimeError" in r["detail"]["health_error"]
     assert r["unit"] == "Gbps"
     assert r["value"] > 0 and math.isfinite(r["value"])
     # vs_baseline is rounded to 4 decimals; at CPU-mesh speeds the
@@ -385,6 +398,7 @@ def test_main_multichip_bad_env_falls_back(capsys, monkeypatch, tmp_path):
     monkeypatch.setattr(bench, "_ep_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_pp_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_obs_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_health_metrics", lambda t: {})
     _, r = _run_main(capsys, monkeypatch, tmp_path)
     # Fell back to the default 24-pair cap: ceil-stride over the 56
     # ordered pairs of an 8-device mesh measures 19 of them.
@@ -409,6 +423,7 @@ def test_main_multichip_device_sourced_cells(capsys, monkeypatch,
     monkeypatch.setattr(bench, "_ep_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_pp_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_obs_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_health_metrics", lambda t: {})
     _, r = _run_main(capsys, monkeypatch, tmp_path)
     d = r["detail"]
     assert d["headline_source"] == "device_trace"
@@ -496,6 +511,10 @@ def test_main_single_chip_branch_schema(capsys, monkeypatch, tmp_path):
     )
     monkeypatch.setattr(
         bench, "_obs_metrics",
+        lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
+    )
+    monkeypatch.setattr(
+        bench, "_health_metrics",
         lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
     )
     detail_path = os.path.join(str(tmp_path), "BENCH_detail.json")
@@ -624,6 +643,7 @@ def test_single_chip_headline_vs_baseline_uses_device_kind(capsys,
     monkeypatch.setattr(bench, "_fsdp_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_tp_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_obs_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_health_metrics", lambda t: {})
     monkeypatch.setattr(
         bench, "_loopback_size_sweep", lambda *a, **kw: [])
     _, r = _run_main(capsys, monkeypatch, tmp_path)
@@ -649,7 +669,8 @@ def test_compact_line_bounded_even_with_bloated_detail():
     # on every headline key must still emit <= 1 KiB — least-important
     # headline entries are dropped from the end first.
     detail = {k: "x" * 200 for k in bench.HEADLINE_KEYS}
-    detail["devices"] = 8
+    detail["devices"] = 8  # feeds the line's top-level "n" (devices
+    # itself left HEADLINE_KEYS in round 12 — n carries it)
     result = {
         "metric": "all_pairs_unidir_bandwidth_avg", "value": 123.456,
         "unit": "Gbps", "vs_baseline": 0.077, "detail": detail,
@@ -661,9 +682,9 @@ def test_compact_line_bounded_even_with_bloated_detail():
     assert r["metric"] == "all_pairs_unidir_bandwidth_avg"
     assert r["value"] == 123.456
     assert r["n"] == 8
-    # Most-important-first: 'devices' (front of HEADLINE_KEYS) is kept
-    # while tail keys were dropped to fit.
-    assert "devices" in r["headline"]
+    # Most-important-first: 'headline_source' (front of HEADLINE_KEYS)
+    # is kept while tail keys were dropped to fit.
+    assert "headline_source" in r["headline"]
     assert len(r["headline"]) < len(bench.HEADLINE_KEYS)
 
 
@@ -799,7 +820,6 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
     # (including every tp_overlap_* and fsdp_* key) inside the budget
     # WITHOUT relying on the drop-from-the-end fallback.
     realistic = {
-        "devices": 256,
         "headline_source": "device_trace",
         "hbm_gbytes_per_s": 657.13,
         "flash_attention_tflops": 140.9,
@@ -819,6 +839,13 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         "ring_achieved_gbps": 1234.56,
         "ag_achieved_gbps": 987.65,
         "obs_step_ms_p50": 123.456,
+        # Round 12: the health trio joined the line; "devices" (the
+        # byte-identical twin of the line's own top-level "n") and
+        # "pairs_measured" (never gated, never drift-quoted) moved to
+        # BENCH_detail.json to make room (the min/max_gbps precedent).
+        "obs_step_ms_p99": 234.567,
+        "health_detect_steps": 2,
+        "heal_resume_loss_delta": 0.019981,
         # Round 11: the dma-transport quartet joined the line; the
         # four *_step_ms_overlap_none baselines moved to
         # BENCH_detail.json (never gated — only the overlap variants
@@ -831,7 +858,6 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         "decode_ms_per_token": 0.123,
         "decode_hbm_ms_per_token": 0.0419,
         "flagship_large_tokens_per_s": 45467,
-        "pairs_measured": 24,
     }
     # Every headline key must have a realistic value in this test —
     # a key added to HEADLINE_KEYS without extending this table would
@@ -876,6 +902,8 @@ def test_obs_metrics_cpu_mesh():
     assert out["obs_source"] is None
     assert out["obs_step_ms_p50"] is not None
     assert out["obs_step_ms_p50"] > 0
+    # The round-12 latency tail rides the same instrumented run.
+    assert out["obs_step_ms_p99"] >= out["obs_step_ms_p50"]
 
 
 def test_obs_headline_keys_survive_compact_budget():
@@ -982,3 +1010,79 @@ def test_overlap_none_baselines_left_the_compact_line():
         assert k not in bench.HEADLINE_KEYS, k
         assert k in {**bench.FSDP_NULL, **bench.TP_NULL,
                      **bench.EP_NULL, **bench.PP_NULL}, k
+
+
+# ------------------------------------------------------ health metric
+
+
+def test_health_metrics_wiring(monkeypatch):
+    # The round-12 gate numbers plumb straight out of run_smoke (the
+    # real injected-fault matrix is tests/test_obs_health.py's
+    # @slow end-to-end; bench must only relay + round). A failing
+    # smoke ("ok": False) publishes the numbers AND the reason.
+    import tpu_p2p.obs.health as health_mod
+
+    from tpu_p2p.utils import timing
+
+    monkeypatch.setattr(
+        health_mod, "run_smoke",
+        lambda out: {"health_detect_steps": 2,
+                     "heal_resume_loss_delta": 0.0199799999,
+                     "ok": True},
+    )
+    out = bench._health_metrics(timing)
+    assert set(out) == set(bench.HEALTH_NULL)
+    assert out["health_detect_steps"] == 2
+    assert out["heal_resume_loss_delta"] == 0.01998  # rounded
+    assert out["health_scenarios_ok"] is True
+    assert out["health_error"] is None
+
+    monkeypatch.setattr(
+        health_mod, "run_smoke",
+        lambda out: {"health_detect_steps": None,
+                     "heal_resume_loss_delta": None, "ok": False},
+    )
+    out = bench._health_metrics(timing)
+    assert out["health_detect_steps"] is None
+    assert out["health_scenarios_ok"] is False
+    assert "incomplete" in out["health_error"]
+
+
+def test_health_metrics_single_device_publishes_null_schema(monkeypatch):
+    # A 1-chip bench run cannot lose a link or a host: the full
+    # HEALTH_NULL schema with the reason, nothing run.
+    import jax
+
+    from tpu_p2p.utils import timing
+
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a, **kw: [object()])
+    out = bench._health_metrics(timing)
+    assert set(out) == set(bench.HEALTH_NULL)
+    assert out["health_detect_steps"] is None
+    assert out["heal_resume_loss_delta"] is None
+    assert "single device" in out["health_error"]
+
+
+def test_health_keys_survive_compact_budget():
+    # Satellite contract (round 12): the health trio rides the ≤1 KiB
+    # compact line at realistic widths.
+    new = ("obs_step_ms_p99", "health_detect_steps",
+           "heal_resume_loss_delta")
+    for k in new:
+        assert k in bench.HEADLINE_KEYS, k
+    detail = {
+        "devices": 256,
+        "obs_step_ms_p99": 234.567,
+        "health_detect_steps": 2,
+        "heal_resume_loss_delta": 0.019981,
+    }
+    result = {
+        "metric": "all_pairs_unidir_bandwidth_avg", "value": 1234.567,
+        "unit": "Gbps", "vs_baseline": 0.7716, "detail": detail,
+    }
+    s = bench._compact_line(result, "BENCH_detail.json")
+    assert len(s.encode()) <= bench.COMPACT_LINE_MAX_BYTES
+    head = json.loads(s)["headline"]
+    for k in new:
+        assert k in head, k

@@ -35,6 +35,46 @@ def test_npz_roundtrip_reshards_across_meshes(tmp_path):
         )
 
 
+def test_npz_reshard_8way_onto_surviving_submeshes(tmp_path):
+    # The heal path's core assumption (docs/health.md): a checkpoint
+    # saved from an 8-way mesh restores bitwise onto ANY smaller
+    # power-of-two submesh — including one built, like
+    # train.run_training_with_heal builds it, from an explicit
+    # survivor device subset (a host died; its devices are gone).
+    cfg = _cfg()
+    params = F.init_flagship_params(cfg)
+    mesh_a = F.build_mesh(8)
+    placed = F.place_flagship_params(params, mesh_a)
+    C.save_params(str(tmp_path / "ck"), placed, step=11)
+    for m in (4, 2, 1):
+        # Drop the LAST device (the smoke's lost host) and build the
+        # m-way mesh from the survivors, exactly as the heal does.
+        devices = [d for i, d in enumerate(mesh_a.devices.flat)
+                   if i != 7][:m]
+        mesh_b = F.build_mesh(m, devices=devices)
+        restored, step = C.load_params(
+            str(tmp_path / "ck"), mesh_b, F.flagship_param_specs(mesh_b)
+        )
+        assert step == 11
+        assert set(restored) == set(params)
+        for k in params:
+            got = np.asarray(restored[k])
+            assert got.dtype == np.asarray(params[k]).dtype, k
+            np.testing.assert_array_equal(
+                got, np.asarray(params[k]),
+                err_msg=f"{k} drifted resharding 8 -> {m}")
+            assert restored[k].sharding.mesh.shape == dict(
+                zip(mesh_b.axis_names, mesh_b.devices.shape)
+            ), k
+        if m > 1:
+            # The restored copies genuinely live on the survivor
+            # subset — a heal that silently placed shards back on the
+            # lost host's device would pass value equality.
+            used = {d for k in params
+                    for d in restored[k].sharding.mesh.devices.flat}
+            assert used == set(devices)
+
+
 def test_npz_detects_torn_checkpoint(tmp_path):
     cfg = _cfg()
     params = F.init_flagship_params(cfg)

@@ -81,6 +81,16 @@ SUMMARY_PATTERNS = {
     # criterion) or _run_cli fails the returncode assert.
     "obs": ["obs", "--cpu-mesh", "8", "--msg-size", "256KiB",
             "--count", "4", "--current", "BENCH_r05.json"],
+    # The round-12 watch subcommand end to end over a checked-in
+    # deterministic obs stream (tests/golden/obs_watch_fixture.jsonl):
+    # one embedded health verdict re-printed + one straggler re-scored
+    # from the step rows (the un-monitored-log path), and the
+    # --expect-alerts exit inversion the injected-fault CI smoke uses
+    # (alerts seen -> rc 0, which _run_cli asserts). Timings are
+    # fixture constants, so this golden pins bytes, not CPU speed.
+    "obs_watch": ["obs", "watch",
+                  "tests/golden/obs_watch_fixture.jsonl",
+                  "--expect-alerts"],
 }
 
 _FIELD = re.compile(r" *\d+\.\d\d")  # a whole padded %6.02f field

@@ -9,6 +9,7 @@ from tpu_p2p.config import (
     REF_ITERS,
     REF_MSG_SIZE,
     format_size,
+    parse_edge,
     parse_size,
     parse_sweep,
 )
@@ -50,6 +51,18 @@ def test_parse_sweep_range_powers_of_two():
 
 def test_parse_sweep_list():
     assert parse_sweep("4KiB,32MiB") == (4096, 32 * 1024 * 1024)
+
+
+def test_parse_edge():
+    # The CLI spelling of a FaultPlan.degrade_edge
+    # (train.py --fault-degrade-edge; docs/health.md).
+    assert parse_edge("0:1") == (0, 1)
+    assert parse_edge("12:3") == (12, 3)
+    # Negative indices would make a silently-inert FaultPlan (the
+    # throttle's edge match can never hit them) — rejected loudly.
+    for bad in ("0", "0:1:2", "a:b", "0-1", "", "-1:0", "0:-2"):
+        with pytest.raises(ValueError, match="SRC:DST"):
+            parse_edge(bad)
 
 
 def test_invalid_enum_values_rejected():

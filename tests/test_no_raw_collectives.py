@@ -108,6 +108,37 @@ def _all_pkg_files():
                 yield os.path.join(dirpath, f)
 
 
+# ------------------------------------------------- fault injection
+# Round 12: the health engine's deterministic fault wrappers
+# (obs/faults.py) are consulted by transport at exactly one point —
+# collectives.ppermute's _fault_throttle, which reads
+# faults.active_plan() at trace time. Any OTHER code consulting the
+# active plan (or applying a throttle) would distort transport in a
+# way the ledger and the health detectors could never attribute, the
+# same hole class as a raw collective in model code. Entry points
+# (faults.injecting / maybe_slow_host / host_lost) are fine anywhere
+# — this lint pins the *application* sites.
+
+_FAULT_CALL = re.compile(
+    r"(?:\bactive_plan|\b_fault_throttle)\s*\("
+)
+
+
+def _fault_call_in(line: str) -> bool:
+    """Call-site check with the line's ``#`` comment stripped: unlike
+    the dotted ``jax.lax.*`` patterns, ``active_plan()`` reads
+    naturally in prose (and does appear in comments describing the
+    default-path cost), so comments are cut before matching rather
+    than trusted to never name the call."""
+    return bool(_FAULT_CALL.search(line.split("#", 1)[0]))
+
+
+FAULT_ALLOWED = (
+    os.path.join("obs", "faults.py"),
+    os.path.join("parallel", "collectives.py"),
+)
+
+
 def test_pallas_transport_only_under_parallel_and_ops():
     offenders = []
     for path in _all_pkg_files():
@@ -137,6 +168,50 @@ def test_pallas_lint_pattern_catches_calls_and_ignores_prose():
         "# built on ``pltpu.make_async_remote_copy`` + semaphores")
     assert not _PALLAS_CALL.search(
         "the ``pl.pallas_call`` interpret path")
+
+
+def test_fault_injection_confined_to_faults_and_collectives():
+    offenders = []
+    for path in _all_pkg_files():
+        rel = os.path.relpath(path, PKG)
+        if rel in FAULT_ALLOWED:
+            continue
+        with open(path) as fh:
+            for lineno, line in enumerate(fh, 1):
+                if _fault_call_in(line):
+                    offenders.append(
+                        f"tpu_p2p/{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "fault-injection application outside tpu_p2p/obs/faults.py "
+        "and tpu_p2p/parallel/collectives.py: a throttle consulted "
+        "from model/workload code distorts transport the ledger (and "
+        "the health detectors) could never attribute. Inject through "
+        "faults.injecting(plan) and let the instrumented wrappers "
+        "apply it:\n  " + "\n  ".join(offenders)
+    )
+
+
+def test_fault_lint_pattern_catches_calls_and_ignores_prose():
+    # Self-test, like the other lints': call sites only.
+    assert _fault_call_in("plan = _faults.active_plan()")
+    assert _fault_call_in("plan = faults.active_plan ()")
+    assert _fault_call_in("y = _fault_throttle(y, x, axis, edges)")
+    assert not _fault_call_in(
+        "x = 1  # one ``active_plan() is None`` check per default path")
+    assert not _fault_call_in(
+        "the ``_fault_throttle`` detour rides the value path")
+
+
+def test_fault_lint_sees_the_wrapper_modules():
+    # The allowlisted files must actually contain the wrappers — if
+    # the throttle moves, the lint must start failing, not silently
+    # allowlist nothing.
+    hits = []
+    for rel in FAULT_ALLOWED:
+        with open(os.path.join(PKG, rel)) as fh:
+            if _FAULT_CALL.search(fh.read()):
+                hits.append(rel)
+    assert os.path.join("parallel", "collectives.py") in hits, hits
 
 
 def test_pallas_lint_sees_the_kernel_modules():

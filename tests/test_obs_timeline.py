@@ -65,7 +65,33 @@ def test_p50_skips_compile_step():
     tl2 = T.StepTimeline(lambda r: None)
     assert tl2.p50_step_ms() is None
     s = tl.summary_record()
-    assert s == {"obs": "summary", "steps": 4, "obs_step_ms_p50": 12.0}
+    assert s == {"obs": "summary", "steps": 4, "obs_step_ms_p50": 12.0,
+                 "obs_step_ms_p99": 14.0}
+
+
+def test_p99_same_sample_as_p50_nearest_rank():
+    # p99 quotes the SAME steady-state sample as p50 (compile step
+    # dropped when > 2 steps ran): nearest-rank percentile, which on
+    # fewer than 100 samples is the worst observed step — exactly the
+    # production-tail number a short run can honestly pin.
+    tl = T.StepTimeline(lambda r: None)
+    tl.step_ms_history = [5000.0, 10.0, 12.0, 900.0, 14.0]
+    assert tl.p99_step_ms() == 900.0  # the compile spike is NOT it
+    # <= 2 steps: nothing dropped, both percentiles over the raw pair.
+    tl2 = T.StepTimeline(lambda r: None)
+    tl2.step_ms_history = [5000.0, 10.0]
+    assert tl2.p50_step_ms() == pytest.approx(2505.0)
+    assert tl2.p99_step_ms() == 5000.0
+    assert T.StepTimeline(lambda r: None).p99_step_ms() is None
+
+
+def test_p99_nearest_rank_on_100_samples():
+    # With >= 100 steady samples the nearest-rank rule stops being
+    # "the max": ceil(0.99 * 100) - 1 = index 98 of the sorted 100.
+    tl = T.StepTimeline(lambda r: None)
+    tl.step_ms_history = [0.0] + [float(i) for i in range(1, 101)]
+    assert tl.p99_step_ms() == 99.0
+    assert tl.p50_step_ms() == 50.5
 
 
 # ------------------------------------------------------ device window
@@ -167,6 +193,11 @@ def test_train_obs_jsonl_end_to_end(tmp_path):
     assert len(summ) == 1
     assert summ[0]["steps"] == 4
     assert summ[0]["obs_step_ms_p50"] == out["obs_step_ms_p50"] > 0
+    assert summ[0]["obs_step_ms_p99"] == out["obs_step_ms_p99"] > 0
+    assert out["obs_step_ms_p99"] >= out["obs_step_ms_p50"]
+    # The health monitor rode the run (a healthy one: no verdicts).
+    assert out["health_verdicts"] == 0
+    assert not any(r["obs"] == "health" for r in recs)
     assert out["obs_ledger_issues"] > 0
     # Training semantics unchanged by observation.
     assert out["steps_run"] == 4

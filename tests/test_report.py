@@ -39,6 +39,30 @@ def test_large_and_nan_cells():
     assert "nan" in buf.getvalue()
 
 
+def test_render_matrix_unmeasured_prints_dashes_not_zero():
+    # Round-12 satellite: a DEAD link measures ~0.00; an UNMEASURED
+    # one (NaN — or None, the JSON artifacts' NaN spelling) must
+    # render distinguishably, or the health engine's link detector
+    # reads absence as failure. Unmeasured cells print a field-width
+    # `--` and stay NaN in reporter.values so the summary never
+    # aggregates them.
+    import io
+
+    from tpu_p2p.utils.report import render_matrix
+
+    buf = io.StringIO()
+    rep = render_matrix(
+        [[math.nan, 10.0], [None, math.nan]], "t", stream=buf)
+    out = buf.getvalue()
+    row0 = [ln for ln in out.splitlines() if ln.startswith("     0")][0]
+    row1 = [ln for ln in out.splitlines() if ln.startswith("     1")][0]
+    assert row0 == "     0   0.00  10.00 "  # diagonal keeps its 0.00
+    assert row1 == "     1     --   0.00 "  # same 7-byte field width
+    assert math.isnan(rep.values[1][0])
+    s = rep.summary()
+    assert s["cells"] == 1 and s["min"] == s["max"] == 10.0
+
+
 def test_summary_off_diagonal_only():
     r = report.MatrixReporter(3, "t", io.StringIO())
     for i in range(3):

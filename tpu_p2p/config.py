@@ -47,6 +47,23 @@ def format_size(nbytes: int) -> str:
     return f"{nbytes}B"
 
 
+def parse_edge(text: str) -> Tuple[int, int]:
+    """Parse ``'0:1'`` → the directed edge ``(0, 1)`` — the CLI
+    spelling of a ``FaultPlan.degrade_edge``
+    (``train.py --fault-degrade-edge``, docs/health.md)."""
+    parts = str(text).split(":")
+    try:
+        src, dst = (int(p) for p in parts)
+        if src < 0 or dst < 0:
+            raise ValueError("negative device index")
+    except ValueError:
+        raise ValueError(
+            f"unparseable edge {text!r}; expected SRC:DST with "
+            "non-negative device indices, e.g. 0:1"
+        ) from None
+    return src, dst
+
+
 def parse_sweep(text: str) -> Tuple[int, ...]:
     """``'1KiB:1GiB'`` → powers-of-two sweep; ``'4KB,32MiB'`` → list."""
     if ":" in text:

@@ -1,0 +1,169 @@
+"""Deterministic fault injection for the fleet health engine.
+
+MegaScale-style health detection (tpu_p2p/obs/health.py) is only
+trustworthy if its detectors fire on *known* faults — and the faults a
+production fleet actually suffers (one degraded ICI link, one slow
+host, one dead host) cannot be summoned on demand, least of all on the
+simulated CPU mesh the tests run on. This module is the controlled
+substitute: a :class:`FaultPlan` describes exactly one fault, and the
+framework's own transport/loop code consults it at well-defined
+points, so every detector in ``health.py`` is testable end to end with
+zero randomness.
+
+The three fault shapes, and where each is applied:
+
+- **Degraded link** (``degrade_edge`` + ``degrade_factor``): the
+  ledger-recorded ``collectives.ppermute`` wrapper routes the shipped
+  value through ``degrade_factor - 1`` extra round trips of the
+  chosen link — each round applies the ``s ↔ d`` swap permutation
+  twice, a bitwise identity that nevertheless traverses the link both
+  directions per application — so host timing, device traces, and the
+  ledger all see a slower link while every computed value stays
+  bitwise identical (the detour rides the VALUE path on purpose: XLA
+  expands optimization barriers away and DCEs dead side-chains, but
+  it never composes collective permutes). The throttle is a
+  TRACE-time decision: programs compiled outside :func:`injecting`
+  stay clean, programs compiled inside it carry the fault (the health
+  probe compiles its per-edge programs under the plan for exactly
+  this reason).
+- **Straggler host** (``slow_rank`` + ``slow_ms``): the training loop
+  calls :func:`maybe_slow_host` once per step inside its step span —
+  a host-side delay of ``slow_ms`` from ``start_step`` on, the
+  deterministic stand-in for one rank's degraded compute. (On the
+  single-process simulated mesh every "rank" shares one host clock,
+  so the delay lands on the fleet's step cadence exactly the way a
+  real straggler's does: every synchronized step waits for it.)
+- **Lost host** (``lost_host``): :func:`host_lost` answers "has host
+  ``h`` stopped heartbeating at ``step``?" — the loop feeds the
+  health monitor heartbeats for every host this predicate still
+  admits, and the monitor's missed-heartbeat rule turns the silence
+  into a ``lost_host`` verdict (then ``train.py --heal`` reshards
+  onto the survivors; docs/health.md).
+
+Fault-injection wrappers live ONLY here and in
+``parallel/collectives.py`` — enforced by the grep-lint in
+tests/test_no_raw_collectives.py, the same way raw collectives are
+confined: a throttle call in model code would distort transport the
+ledger (and the detectors) could never attribute.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+__all__ = ["FaultPlan", "injecting", "active_plan", "host_lost",
+           "maybe_slow_host"]
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """One deterministic injected fault (exactly one of the three
+    shapes; a plan may carry several, but the smoke scenarios use one
+    each so attribution is unambiguous).
+
+    ``start_step`` gates the step-indexed faults (slow/lost): the
+    fault is absent before it, present from it on — detectors are
+    graded on how many steps past ``start_step`` their verdict lands
+    (``health_detect_steps``). The link throttle has no step index
+    (it is baked into whatever programs compile under the plan).
+    """
+
+    degrade_edge: Optional[Tuple[int, int]] = None
+    degrade_factor: int = 8  # total trips per ship on the chosen edge
+    slow_rank: Optional[int] = None
+    slow_ms: float = 0.0  # injected per-step host delay
+    lost_host: Optional[int] = None
+    start_step: int = 0
+
+    def __post_init__(self) -> None:
+        if self.degrade_edge is not None:
+            s, d = self.degrade_edge
+            if int(s) == int(d):
+                raise ValueError(
+                    f"degrade_edge {self.degrade_edge} is a self-edge; "
+                    "the throttle targets an inter-device link"
+                )
+            if self.degrade_factor < 2:
+                raise ValueError(
+                    f"degrade_factor must be >= 2 (1 is a healthy "
+                    f"link), got {self.degrade_factor}"
+                )
+        if self.slow_rank is not None and self.slow_ms <= 0:
+            raise ValueError(
+                f"slow_rank={self.slow_rank} needs slow_ms > 0, got "
+                f"{self.slow_ms}"
+            )
+        if self.start_step < 0:
+            raise ValueError(f"start_step must be >= 0, got "
+                             f"{self.start_step}")
+
+    def describe(self) -> str:
+        parts: List[str] = []
+        if self.degrade_edge is not None:
+            parts.append(f"degrade link {self.degrade_edge[0]}->"
+                         f"{self.degrade_edge[1]} x{self.degrade_factor}")
+        if self.slow_rank is not None:
+            parts.append(f"slow rank {self.slow_rank} by "
+                         f"{self.slow_ms:g} ms/step")
+        if self.lost_host is not None:
+            parts.append(f"lose host {self.lost_host}")
+        tail = f" from step {self.start_step}" if self.start_step else ""
+        return ("; ".join(parts) or "no-op plan") + tail
+
+
+# One active plan, not a stack: faults are a diagnostic mode and two
+# concurrent plans would make every detector's attribution ambiguous.
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The currently injected plan, or None (the default — every
+    consult is then one comparison against None)."""
+    return _ACTIVE
+
+
+@contextmanager
+def injecting(plan: FaultPlan):
+    """Activate ``plan`` for the dynamic extent of the block.
+
+    Nested activation is refused: overlapping plans cannot be
+    attributed. Remember the link throttle applies at TRACE time —
+    enter the block before compiling the programs that should carry
+    the fault.
+    """
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError(
+            f"a fault plan is already active ({_ACTIVE.describe()}); "
+            "nested injection would make detector attribution ambiguous"
+        )
+    _ACTIVE = plan
+    try:
+        yield plan
+    finally:
+        _ACTIVE = None
+
+
+def host_lost(plan: Optional[FaultPlan], host: int, step: int) -> bool:
+    """Has ``host`` stopped heartbeating at global ``step`` under
+    ``plan``? The loop feeds the health monitor heartbeats only for
+    hosts this returns False for."""
+    return (plan is not None and plan.lost_host is not None
+            and int(host) == int(plan.lost_host)
+            and int(step) >= plan.start_step)
+
+
+def maybe_slow_host(plan: Optional[FaultPlan], step: int,
+                    sleep=time.sleep) -> bool:
+    """Apply the straggler delay for global ``step`` (the training
+    loop calls this once per step inside its step span). → True when
+    a delay was injected — callers never need to re-derive the
+    condition."""
+    if (plan is not None and plan.slow_rank is not None
+            and int(step) >= plan.start_step):
+        sleep(plan.slow_ms / 1e3)
+        return True
+    return False

@@ -43,7 +43,9 @@ for reduce-scatter and all-to-all; ``2(n-1)/n × buffer`` for
 all-reduce. Aggregates: per-kind and per-axis summaries, and — for
 edge-carrying (ppermute) entries, whose participants are known
 per-link — the N×N achieved-bandwidth matrix, rendered with the same
-matrix formatting as the workloads (``utils/report.py``).
+matrix formatting as the workloads (``utils/report.py``; unmeasured
+links print ``--``, never ``0.00`` — a dead link must stay
+distinguishable from an unprobed one, the health engine's contract).
 """
 
 from __future__ import annotations

@@ -46,14 +46,21 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Tolerance", "TOLERANCES", "headline_from_artifact",
-           "load_trajectory", "compare", "write_multichip_artifact",
-           "main"]
+           "load_trajectory", "load_multichip_history", "compare",
+           "write_multichip_artifact", "main"]
 
 
 @dataclass(frozen=True)
 class Tolerance:
     better: str  # "higher" | "lower"
     rel: float  # allowed fractional regression vs the best prior
+    # "lower" keys only: values at or below this absolute level never
+    # regress, whatever the best prior ratcheted down to. For
+    # near-zero noise-floor keys (a parity residual like
+    # heal_resume_loss_delta legitimately swings orders of magnitude
+    # between rounds) the min-ratchet alone would turn one lucky
+    # round into a permanent unpassable floor.
+    abs_floor: float = 0.0
 
 
 # Per-key gate tolerances. rel is deliberately loose where the
@@ -92,6 +99,17 @@ TOLERANCES: Dict[str, Tolerance] = {
     "p2p_lat_us_pallas": Tolerance("lower", 0.50),
     "ring_gbps_xla": Tolerance("higher", 0.25),
     "ring_gbps_pallas": Tolerance("higher", 0.25),
+    # PR 7 health-engine keys (bench.py _health_metrics + the
+    # timeline's latency tail). p99 rides host-loop jitter harder than
+    # p50 (50%); detect_steps is a small integer (100% = one extra
+    # step of latency allowed); the heal loss delta is a near-zero
+    # cross-mesh reduction-order residual — an absolute floor does the
+    # real gating (any delta <= 0.05 passes; the smoke's own relative
+    # gate is stricter), because one lucky near-cancellation round
+    # would otherwise min-ratchet an unpassable reference.
+    "obs_step_ms_p99": Tolerance("lower", 0.50),
+    "health_detect_steps": Tolerance("lower", 1.00),
+    "heal_resume_loss_delta": Tolerance("lower", 1.00, abs_floor=0.05),
 }
 
 _TAIL_KV = re.compile(
@@ -213,7 +231,8 @@ def compare(current: Dict[str, float],
         if tol.better == "higher":
             bad = ref > 0 and cur < ref * (1.0 - tol.rel)
         else:
-            bad = ref > 0 and cur > ref * (1.0 + tol.rel)
+            floor = max(ref * (1.0 + tol.rel), tol.abs_floor)
+            bad = (ref > 0 or tol.abs_floor > 0) and cur > floor
         rows.append({"key": key, "current": cur, "ref": ref,
                      "ratio": ratio,
                      "verdict": "REGRESSED" if bad else "OK"})
@@ -312,6 +331,50 @@ def write_multichip_artifact(join, n: int, artifacts_dir: str = ".",
     return path
 
 
+def load_multichip_history(artifacts_dir: str = "."):
+    """Per-link historical baseline from the ``MULTICHIP_r*.json``
+    sequence: the elementwise BEST (max) achieved Gbps each directed
+    link ever published — the link detector's "regressed against its
+    own past" reference (:func:`tpu_p2p.obs.health.
+    detect_degraded_links` ``baseline=``), the per-link twin of this
+    gate's best-prior rule.
+
+    Only ``obs_link_matrix`` artifacts contribute (the driver also
+    writes dryrun-status files under the same name pattern — skipped,
+    like the gate skips unparseable rounds). → N×N list-of-lists with
+    None where no round measured the link, or None when no usable
+    history exists.
+    """
+    best: Optional[List[List[float]]] = None
+    for p in sorted(glob.glob(os.path.join(artifacts_dir,
+                                           "MULTICHIP_r*.json"))):
+        try:
+            with open(p) as fh:
+                art = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        m = art.get("matrix_gbps")
+        if art.get("kind") != "obs_link_matrix" or not m:
+            continue
+        # Grow to the largest mesh seen: a fleet that expanded after
+        # a small early round must not have its new links' history
+        # silently truncated to the first artifact's shape.
+        n = max(len(m), max((len(r) for r in m), default=0),
+                len(best) if best is not None else 0)
+        if best is None:
+            best = [[None] * n for _ in range(n)]
+        elif n > len(best):
+            for row in best:
+                row.extend([None] * (n - len(row)))
+            best.extend([None] * n for _ in range(n - len(best)))
+        for i, row in enumerate(m):
+            for j, v in enumerate(row):
+                if _numeric(v):
+                    cur = best[i][j]
+                    best[i][j] = v if cur is None else max(cur, v)
+    return best
+
+
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tpu_p2p obs",
@@ -341,6 +404,19 @@ def _build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "watch":
+        # ``python -m tpu_p2p obs watch <obs.jsonl>`` — tail a step
+        # timeline and alert on health verdicts (docs/health.md).
+        from tpu_p2p.obs.health import watch_main
+
+        return watch_main(argv[1:])
+    if argv and argv[0] == "smoke":
+        # ``python -m tpu_p2p obs smoke`` — the injected-fault health
+        # smoke matrix (make health; docs/health.md).
+        from tpu_p2p.obs.health import smoke_main
+
+        return smoke_main(argv[1:])
     args = _build_parser().parse_args(argv)
     from tpu_p2p.utils.errors import fail_fast
 

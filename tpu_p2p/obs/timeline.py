@@ -32,6 +32,7 @@ simulated CPU mesh) every device field is an explicit null.
 
 from __future__ import annotations
 
+import math
 import statistics
 import time
 from contextlib import contextmanager
@@ -94,21 +95,40 @@ class StepTimeline:
         self._emit(rec)
         return rec
 
+    def _steady_history(self) -> List[float]:
+        """Step times with the first (compile-carrying) step dropped
+        when more than two steps ran — the sample both percentiles
+        quote, so p50 and p99 can never disagree about what a step
+        is."""
+        h = self.step_ms_history
+        return h[1:] if len(h) > 2 else h
+
     def p50_step_ms(self) -> Optional[float]:
         """p50 of emitted step rows' wall times — skipping the first
         step (it carries compilation) when more than two steps ran."""
-        h = self.step_ms_history
+        h = self._steady_history()
         if not h:
             return None
-        if len(h) > 2:
-            h = h[1:]
         return round(float(statistics.median(h)), 3)
+
+    def p99_step_ms(self) -> Optional[float]:
+        """p99 of the same sample — the production latency tail
+        (nearest-rank percentile: the worst observed step for fewer
+        than 100 samples, which is exactly what a tail gate should
+        pin on short runs)."""
+        h = self._steady_history()
+        if not h:
+            return None
+        h = sorted(h)
+        idx = max(0, math.ceil(0.99 * len(h)) - 1)
+        return round(float(h[idx]), 3)
 
     def summary_record(self) -> dict:
         return {
             "obs": "summary",
             "steps": len(self.step_ms_history),
             "obs_step_ms_p50": self.p50_step_ms(),
+            "obs_step_ms_p99": self.p99_step_ms(),
         }
 
 

@@ -744,13 +744,60 @@ def psum(x, axis, *, label: str = "psum"):
     return jax.lax.psum(x, axis)
 
 
+def _fault_throttle(y, axis, edges):
+    """Apply an active :class:`tpu_p2p.obs.faults.FaultPlan` link
+    throttle to one just-issued ship over ``edges``.
+
+    When a plan degrading an edge ``(s, d)`` of this ship is active
+    (trace time!), the shipped value takes ``degrade_factor - 1``
+    extra round trips through the degraded link before it is
+    returned: each round applies the swap permutation π (``s ↔ d``,
+    identity self-edges elsewhere) TWICE, so the composition is the
+    bitwise identity — pure value movement, no arithmetic — while the
+    link genuinely carries two extra traversals per direction per
+    round. The detour sits on the VALUE path, which is what makes it
+    robust: XLA happily expands optimization barriers away and DCEs a
+    dead side-chain (measured on the CPU backend), but it never
+    composes collective permutes, so host timing, device traces, and
+    the ledger (``fault_throttle`` rows) all see the slow link. The
+    default path costs one ``active_plan() is None`` check.
+    Fault-injection wrappers live only here and in
+    ``tpu_p2p/obs/faults.py`` (tests/test_no_raw_collectives.py lints
+    it); docs/health.md has the FaultPlan schema.
+    """
+    from tpu_p2p.obs import faults as _faults
+
+    plan = _faults.active_plan()
+    if plan is None or plan.degrade_edge is None:
+        return y
+    edge = (int(plan.degrade_edge[0]), int(plan.degrade_edge[1]))
+    if edge not in edges:
+        return y
+    n = int(jax.lax.axis_size(axis))
+    s, d = edge
+    if s >= n or d >= n:
+        return y  # plan written for a bigger mesh — nothing to slow
+    swap = tuple((i, i) for i in range(n) if i not in (s, d)) \
+        + ((s, d), (d, s))
+    extra = plan.degrade_factor - 1
+    _record_issue("ppermute", axis, nbytes=_aval_bytes(y),
+                  axis_size=n, edges=((s, d), (d, s)), count=2 * extra,
+                  label="fault_throttle")
+    for _ in range(extra):
+        y = jax.lax.ppermute(jax.lax.ppermute(y, axis, swap), axis,
+                             swap)
+    return y
+
+
 def ppermute(x, axis, edges, *, label: str = "ppermute"):
-    """Ledger-recorded ``jax.lax.ppermute``."""
+    """Ledger-recorded ``jax.lax.ppermute`` — and the fault-injection
+    point for link-degradation plans (:func:`_fault_throttle`)."""
+    edges = tuple((int(s), int(d)) for s, d in edges)
     _record_issue("ppermute", axis, nbytes=_aval_bytes(x),
                   axis_size=jax.lax.axis_size(axis),
-                  edges=tuple((int(s), int(d)) for s, d in edges),
-                  label=label)
-    return jax.lax.ppermute(x, axis, edges)
+                  edges=edges, label=label)
+    return _fault_throttle(jax.lax.ppermute(x, axis, edges), axis,
+                           edges)
 
 
 def dma_ppermute(x, axis, edges, *, label: str = "dma_ppermute"):

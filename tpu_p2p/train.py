@@ -98,7 +98,8 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
                  weight_decay: float = 0.0, eval_every: int = 0,
                  eval_batches: int = 2, clip_norm: float = 0.0,
                  warmup_steps: int = 0, schedule: str = "constant",
-                 obs_jsonl: Optional[str] = None) -> dict:
+                 obs_jsonl: Optional[str] = None, fault_plan=None,
+                 heal: bool = False, health_config=None) -> dict:
     """Train the flagship for ``steps`` global steps; returns a summary
     dict (``final_loss``, ``steps_run``, ``start_step``, ...).
 
@@ -128,6 +129,20 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
     blocks on the loss every step so ``step_ms`` is real step cadence,
     not dispatch time — observability costs one sync per step and the
     records say so by existing.
+
+    ``obs_jsonl`` also arms the health engine (docs/health.md): a
+    :class:`tpu_p2p.obs.health.HealthMonitor` scores every step row
+    (median/MAD straggler detection) and tracks per-host heartbeats,
+    emitting ``{"obs": "health"}`` verdict records into the same
+    stream. ``fault_plan`` injects one deterministic fault
+    (:class:`tpu_p2p.obs.faults.FaultPlan` — the loop applies the
+    straggler delay, withholds the lost host's heartbeats, and
+    compiles its programs under the plan so a link throttle lands in
+    the step's transport). ``heal=True`` turns a lost-host verdict
+    into a raised :class:`~tpu_p2p.obs.health.HostLostError` —
+    :func:`run_training_with_heal` catches it and reshards onto the
+    surviving submesh; ``health_config`` overrides the detector
+    thresholds.
     """
     import jax
     from jax.sharding import NamedSharding
@@ -327,14 +342,31 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
 
     import contextlib
 
-    tl = led = None
+    if heal and not (obs_jsonl and ckpt_dir and ckpt_every):
+        raise ValueError(
+            "heal=True needs obs_jsonl (the monitor that detects the "
+            "lost host), ckpt_dir, and ckpt_every (the checkpoint the "
+            "heal reshards from)"
+        )
+    tl = led = monitor = None
+    _faults = None
+    if fault_plan is not None:
+        from tpu_p2p.obs import faults as _faults_mod
+
+        _faults = _faults_mod
     obs_trace_step = None
     if obs_jsonl:
         from tpu_p2p.obs import ledger as obs_ledger
+        from tpu_p2p.obs.health import HealthMonitor, HostLostError
         from tpu_p2p.obs.timeline import StepTimeline, device_window_record
 
         tl = StepTimeline(emit_obs)
         led = obs_ledger.CollectiveLedger()
+        # The always-on health half of the obs layer: straggler
+        # scoring on every step row + heartbeat-based lost-host
+        # tracking, verdicts into the same stream (docs/health.md).
+        monitor = HealthMonitor(health_config, emit=emit_obs,
+                                n_hosts=int(mesh.devices.size))
         # One sampled device-trace window per run (tracing every step
         # is the kind of overhead observability must not add): the
         # SECOND executed step — the first carries XLA compilation.
@@ -356,6 +388,12 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
             from tpu_p2p.obs import ledger as obs_ledger
 
             _obs_stack.enter_context(obs_ledger.recording(led))
+        if _faults is not None:
+            # The plan wraps the loop so the step programs COMPILE
+            # under it — a link throttle is a trace-time rewrite
+            # (obs/faults.py), and a program compiled outside the
+            # plan would be the healthy one.
+            _obs_stack.enter_context(_faults.injecting(fault_plan))
         for step in range(start_step, steps):
             with _span("data"):
                 x, t = next(loader)
@@ -378,6 +416,11 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
                         # Obs mode syncs every step: step_ms must be
                         # the step's real cadence, not dispatch time.
                         jax.block_until_ready(loss)
+                if _faults is not None:
+                    # Deterministic straggler injection: the delay
+                    # rides inside the step span, so step_ms carries
+                    # it exactly the way a real slow rank's wait would.
+                    _faults.maybe_slow_host(fault_plan, step + 1)
             dev_rec = None
             if td_obs is not None:
                 import shutil
@@ -414,9 +457,34 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
                     extra = {k: dev_rec[k] for k in
                              ("device_busy_frac", "gather_overlap_frac",
                               "tp_overlap_frac")}
-                tl.end_step(step + 1, extra=extra)
+                step_rec = tl.end_step(step + 1, extra=extra)
                 if dev_rec is not None:
                     emit_obs(dev_rec)
+                if monitor is not None:
+                    alive = None
+                    if _faults is not None:
+                        alive = [
+                            h for h in range(int(mesh.devices.size))
+                            if not _faults.host_lost(fault_plan, h,
+                                                     step + 1)
+                        ]
+                    for v in monitor.observe_step(
+                            step + 1, step_rec["step_ms"],
+                            alive_hosts=alive,
+                            # The compile step and the traced sample
+                            # step are instrumentation artifacts, not
+                            # fleet health — keep them out of the
+                            # straggler statistic (heartbeats still
+                            # count).
+                            score_straggler=(step not in
+                                             (start_step,
+                                              obs_trace_step))):
+                        if heal and v.kind == "lost_host":
+                            # The elastic-resume signal:
+                            # run_training_with_heal reshards the
+                            # latest checkpoint onto the survivors.
+                            raise HostLostError(v.detail["host"],
+                                                step + 1)
     ran = max(0, steps - start_step)
     if ran and ckpt_dir and saved_at != steps:  # rolling save may have
         # already written this exact state — don't gather it twice
@@ -432,8 +500,79 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
         summary = tl.summary_record()
         emit_obs(summary)
         out["obs_step_ms_p50"] = summary["obs_step_ms_p50"]
+        out["obs_step_ms_p99"] = summary["obs_step_ms_p99"]
         out["obs_ledger_issues"] = len(led)
+        out["health_verdicts"] = len(monitor.verdicts)
     return out
+
+
+def run_training_with_heal(mesh, cfg, *, steps: int,
+                           fault_plan=None, resume: bool = False,
+                           **kw) -> dict:
+    """:func:`run_training` wrapped in the self-healing elastic-resume
+    protocol (docs/health.md; ``python -m tpu_p2p.train --heal``).
+
+    Runs normally until the health monitor declares a host lost
+    (:class:`~tpu_p2p.obs.health.HostLostError`), then: drops the lost
+    host's devices, builds the largest power-of-two surviving submesh
+    (mesh axes must divide the model dims — a 7-device mesh would
+    not), reshards the latest rolling checkpoint onto it (the
+    ``utils/checkpoint.load_params`` ``device_put`` resume path
+    ``run_training`` already has), and resumes to ``steps``. The
+    deterministic per-step batch stream makes the healed run consume
+    exactly the batches the uninterrupted run would have, so final-
+    loss parity is meaningful (``obs smoke`` pins it; bench publishes
+    ``heal_resume_loss_delta`` under the gate). Requires ``ckpt_dir``
+    + ``ckpt_every`` + ``obs_jsonl`` in ``kw`` (run_training
+    validates). The returned summary carries a ``heal`` dict
+    (``lost_host``, ``detected_step``, ``resume_step``, ``devices``);
+    an uninterrupted run returns with ``heal=None``. ``resume``
+    applies to the INITIAL run (continuing an earlier checkpointed
+    run under heal protection); the post-heal half always resumes.
+    """
+    from tpu_p2p.obs.health import HostLostError
+
+    kw = dict(kw)
+    kw.pop("heal", None)  # the wrapper owns this knob
+    kw.pop("resume", None)
+    try:
+        out = run_training(mesh, cfg, steps=steps, resume=resume,
+                           fault_plan=fault_plan, heal=True, **kw)
+        out["heal"] = None
+        return out
+    except HostLostError as e:
+        from tpu_p2p.models import flagship as F
+        from tpu_p2p.utils import checkpoint as C
+
+        ckpt_dir = kw.get("ckpt_dir")
+        if not (ckpt_dir and os.path.exists(
+                os.path.join(ckpt_dir, "params.npz"))):
+            raise RuntimeError(
+                f"host {e.host} lost at step {e.step}, but no "
+                f"checkpoint exists under {ckpt_dir!r} to heal from "
+                "(ckpt_every never fired?)"
+            ) from e
+        with open(os.path.join(ckpt_dir, C._META)) as fh:
+            resume_step = json.load(fh).get("step", 0)
+        devices = [d for i, d in enumerate(mesh.devices.flat)
+                   if i != e.host]
+        m = 1
+        while m * 2 <= len(devices):
+            m *= 2
+        new_mesh = F.build_mesh(m, devices=devices)
+        heal_rec = {"obs": "heal", "lost_host": e.host,
+                    "detected_step": e.step,
+                    "resume_step": resume_step, "devices": m}
+        obs_jsonl = kw.get("obs_jsonl")
+        if obs_jsonl:
+            with open(obs_jsonl, "a") as fh:
+                fh.write(json.dumps(heal_rec) + "\n")
+        # The resumed half runs fault-free: the lost host's devices
+        # are gone from the mesh, and its plan must not re-trigger.
+        out = run_training(new_mesh, cfg, steps=steps, resume=True,
+                           **kw)
+        out["heal"] = {k: v for k, v in heal_rec.items() if k != "obs"}
+        return out
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -467,6 +606,28 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval-batches", type=int, default=2, metavar="K")
     p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
                    help="testing: force CPU platform with N simulated devices")
+    # Health engine (docs/health.md): self-healing resume + the
+    # deterministic fault-injection knobs the smoke matrix uses.
+    p.add_argument("--heal", action="store_true",
+                   help="on a lost-host health verdict, reshard the "
+                        "latest checkpoint onto the surviving "
+                        "power-of-two submesh and resume (requires "
+                        "--obs-jsonl, --ckpt-dir and --ckpt-every)")
+    p.add_argument("--fault-degrade-edge", default=None, metavar="S:D",
+                   help="inject: throttle the directed ppermute link "
+                        "S->D (obs/faults.py FaultPlan)")
+    p.add_argument("--fault-degrade-factor", type=int, default=8,
+                   metavar="K", help="trips per ship on the degraded "
+                                     "edge (>= 2)")
+    p.add_argument("--fault-slow-rank", type=int, default=None,
+                   metavar="R", help="inject: delay rank R's step")
+    p.add_argument("--fault-slow-ms", type=float, default=100.0,
+                   metavar="MS", help="injected per-step delay")
+    p.add_argument("--fault-lost-host", type=int, default=None,
+                   metavar="H", help="inject: host H stops "
+                                     "heartbeating")
+    p.add_argument("--fault-at-step", type=int, default=0, metavar="K",
+                   help="first step the slow/lost fault applies to")
     # Model shape (FlagshipConfig fields).
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=256)
@@ -543,16 +704,37 @@ def main(argv=None) -> int:
         tp_overlap=args.tp_overlap, ep_overlap=args.ep_overlap,
         pp_overlap=args.pp_overlap, pp_chunks=args.pp_chunks,
     )
-    summary = run_training(
-        mesh, cfg, steps=args.steps, lr=args.lr, seed=args.seed,
+    fault_plan = None
+    if (args.fault_degrade_edge or args.fault_slow_rank is not None
+            or args.fault_lost_host is not None):
+        from tpu_p2p.config import parse_edge
+        from tpu_p2p.obs.faults import FaultPlan
+
+        fault_plan = FaultPlan(
+            degrade_edge=(parse_edge(args.fault_degrade_edge)
+                          if args.fault_degrade_edge else None),
+            degrade_factor=args.fault_degrade_factor,
+            slow_rank=args.fault_slow_rank,
+            slow_ms=args.fault_slow_ms,
+            lost_host=args.fault_lost_host,
+            start_step=args.fault_at_step,
+        )
+    common = dict(
+        steps=args.steps, lr=args.lr, seed=args.seed,
         log_every=args.log_every, ckpt_dir=args.ckpt_dir,
-        ckpt_every=args.ckpt_every, resume=args.resume,
+        ckpt_every=args.ckpt_every,
         log_path=args.log_jsonl, log_stream=sys.stdout,
         optimizer=args.optimizer, weight_decay=args.weight_decay,
         eval_every=args.eval_every, eval_batches=args.eval_batches,
         clip_norm=args.clip_norm, warmup_steps=args.warmup_steps,
         schedule=args.schedule, obs_jsonl=args.obs_jsonl,
+        fault_plan=fault_plan,
     )
+    if args.heal:
+        summary = run_training_with_heal(mesh, cfg,
+                                         resume=args.resume, **common)
+    else:
+        summary = run_training(mesh, cfg, resume=args.resume, **common)
     summary.pop("params")
     print(json.dumps({"summary": summary}))
     return 0

@@ -111,9 +111,13 @@ def render_matrix(values, title: str,
     whole matrix — the obs ledger's trace-join
     (:mod:`tpu_p2p.obs.ledger`) — render it here in the identical
     byte format. NaN cells (links the ledger saw no traffic on) print
-    as the reference's ``0.00`` placeholder but stay NaN in
-    ``reporter.values``, so :meth:`MatrixReporter.summary` aggregates
-    only measured links.
+    as a field-width ``--`` and stay NaN in ``reporter.values``: a
+    DEAD link measures ~0.00 and must stay distinguishable from an
+    unmeasured one (the health engine's per-link detector reads this
+    matrix — docs/health.md), and
+    :meth:`MatrixReporter.summary` aggregates only measured links.
+    ``None`` counts as unmeasured too (the JSON artifacts' NaN
+    spelling).
     """
     n = len(values)
     rep = MatrixReporter(n, title, stream)
@@ -124,8 +128,8 @@ def render_matrix(values, title: str,
             v = values[src][dst]
             if src == dst:
                 rep.diagonal(src)
-            elif math.isnan(v):
-                rep._w("%6.02f " % 0.0)  # placeholder; values[] stays NaN
+            elif v is None or math.isnan(v):
+                rep._w("%6s " % "--")  # unmeasured; values[] stays NaN
             else:
                 rep.cell(src, dst, v)
         rep.end_row()
