@@ -161,6 +161,26 @@ def test_compare_lower_better_and_best_prior_reference():
     assert rows["flagship_step_ms"]["verdict"] == "OK"
 
 
+def test_compare_abs_floor_shields_near_zero_lower_keys():
+    # heal_resume_loss_delta is a near-zero reduction-order residual:
+    # one lucky near-cancellation round must not min-ratchet an
+    # unpassable reference. Values at or below the absolute floor
+    # (0.05) always pass; a genuinely broken heal still fails.
+    key = "heal_resume_loss_delta"
+    assert R.TOLERANCES[key].abs_floor == 0.05
+    rows = _rows_by_key(R.compare(
+        {key: 0.02}, [("r1", {key: 1e-6})]))  # 20000x the lucky ref
+    assert rows[key]["verdict"] == "OK"
+    rows = _rows_by_key(R.compare(
+        {key: 0.5}, [("r1", {key: 1e-6})]))  # a real heal failure
+    assert rows[key]["verdict"] == "REGRESSED"
+    # Even a published 0.0 reference (historical artifact) cannot
+    # disable the floor for lower keys that carry one.
+    rows = _rows_by_key(R.compare(
+        {key: 0.5}, [("r1", {key: 0.0})]))
+    assert rows[key]["verdict"] == "REGRESSED"
+
+
 def test_compare_missing_keys_skip_never_fail():
     rows = _rows_by_key(R.compare({}, [("r1", {})]))
     assert all(r["verdict"] == "SKIP" for r in rows.values())
