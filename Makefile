@@ -17,7 +17,7 @@ SHELL    := /bin/bash
 
 NATIVE_SO := native/libtpu_p2p_native.so
 
-.PHONY: all native run test tier1 bench obs health clean
+.PHONY: all native run test tier1 bench obs health serve clean
 
 all: native
 
@@ -61,6 +61,13 @@ obs:
 # empty ARGS="--steps 12" on real hardware).
 health:
 	$(PYTHON) -m tpu_p2p obs smoke $(if $(ARGS),$(ARGS),--cpu-mesh 8)
+
+# Serving-engine smoke (docs/serving.md): paged KV cache + continuous
+# batching over a synthetic Poisson trace, continuous-vs-static A/B on
+# the same requests. Defaults to the simulated 8-device CPU mesh so it
+# runs anywhere; override with ARGS= on real hardware.
+serve:
+	$(PYTHON) -m tpu_p2p serve $(if $(ARGS),$(ARGS),--cpu-mesh 8)
 
 # `make train ARGS="--steps 100 --ckpt-dir runs/a"` — the training
 # loop (tpu_p2p/train.py): loader + step + checkpoint/resume + JSONL.

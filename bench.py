@@ -69,7 +69,6 @@ HEADLINE_KEYS = (
     "flagship_large_step_ms",
     "flagship_large_mfu",
     "latency_8b_p50_us",
-    "latency_8b_oneop_p50_us",
     "fsdp_overlap_frac",
     "fsdp_step_ms_overlap_prefetch",
     "tp_overlap_frac",
@@ -79,7 +78,6 @@ HEADLINE_KEYS = (
     "pp_overlap_frac",
     "pp_step_ms_overlap_wave",
     "ring_achieved_gbps",
-    "ag_achieved_gbps",
     "obs_step_ms_p50",
     "obs_step_ms_p99",
     "health_detect_steps",
@@ -88,10 +86,12 @@ HEADLINE_KEYS = (
     "p2p_lat_us_pallas",
     "ring_gbps_xla",
     "ring_gbps_pallas",
+    "serve_tokens_per_s",
+    "serve_tokens_per_s_static",
+    "serve_ttft_ms_p50",
+    "serve_tok_ms_p99",
     "flagship_step_ms",
     "decode_ms_per_token",
-    "decode_hbm_ms_per_token",
-    "flagship_large_tokens_per_s",
     # min_gbps/max_gbps retired from the compact line in round 10 (the
     # pp_* keys took their bytes): they were the designed drop-first
     # tail — never graded, never gated (obs/regress.py TOLERANCES),
@@ -106,6 +106,19 @@ HEADLINE_KEYS = (
     # line's own top-level "n") and "pairs_measured" (never gated,
     # still in BENCH_detail.json) to make room for the health trio
     # obs_step_ms_p99 / health_detect_steps / heal_resume_loss_delta.
+    # Round 13 applied it to four more to make room for the serve
+    # quartet: flagship_large_tokens_per_s (byte-derivable from
+    # flagship_large_step_ms at the fixed 4×4096-token batch),
+    # latency_8b_oneop_p50_us (the dispatch-inclusive diagnostic
+    # companion; latency_8b_p50_us remains the graded floor),
+    # ag_achieved_gbps (null on every 1-chip round to date; its
+    # ring_achieved_gbps twin stays as the transport sentinel and the
+    # per-link truth persists in the MULTICHIP_r*.json artifacts), and
+    # decode_hbm_ms_per_token (it stood in for the serving regime the
+    # serve_* keys now grade directly). All four still measure,
+    # persist in BENCH_detail.json, and — per the gate's own
+    # tolerance-⊆-headline rule — their tolerances retired with them
+    # (keys accrete and retire round over round by design).
 )
 
 
@@ -1168,6 +1181,163 @@ def _health_metrics(timing):
     return out
 
 
+# Null shape of _serve_metrics — failure must produce the same keys
+# (schema stability, mirroring the other NULL schemas), serve_error
+# naming WHY the nulls published.
+SERVE_NULL = {
+    "serve_devices": None,
+    "serve_tokens_per_s": None,
+    "serve_tokens_per_s_static": None,
+    "serve_ttft_ms_p50": None,
+    "serve_ttft_ms_p99": None,
+    "serve_tok_ms_p50": None,
+    "serve_tok_ms_p99": None,
+    "serve_steps_continuous": None,
+    "serve_steps_static": None,
+    "serve_trace_tokens": None,
+    "serve_error": None,
+    "serve_source": None,
+}
+
+# The graded serving shape (module constants so the CPU test suite can
+# shrink them, like BENCH_SWEEP_CAP_BYTES does for the size ladders):
+# 32 slots of the decode probe's model family (GQA 2:1, Dh=64, bf16),
+# a 256-token page window, 8-token prefill chunks, and a 48-request
+# Poisson trace with staggered prompt/output lengths — staggering is
+# what static run-to-completion batching pays for and continuous
+# batching reclaims.
+SERVE_SLOTS = 32
+SERVE_PAGE_LEN = 32
+SERVE_MAX_BLOCKS = 8
+SERVE_CHUNK = 8
+SERVE_REQUESTS = 48
+SERVE_RATE = 4.0
+SERVE_PROMPT = (16, 96)
+SERVE_GEN = (16, 64)
+SERVE_VOCAB = 2048
+SERVE_DTYPE = "bfloat16"
+
+
+def _serve_model_cfg():
+    from tpu_p2p.models import flagship as F
+
+    return F.FlagshipConfig(
+        batch=SERVE_SLOTS, seq=64, heads=8, kv_heads=2, head_dim=64,
+        stages=2, microbatches=1, dense_ffn=True, moe_mult=2,
+        vocab=SERVE_VOCAB, norm=True, rope=True, dtype=SERVE_DTYPE,
+    )
+
+
+def _serve_metrics(timing):
+    """Serving-engine throughput + latency (round 13 tentpole —
+    tpu_p2p/serve/, docs/serving.md).
+
+    ``serve_tokens_per_s`` / ``serve_tokens_per_s_static``: the
+    continuous-vs-static batching A/B. The SCHEDULER is simulated on
+    the host (scheduling is length-driven, so the exact per-step input
+    sequence is known without a device — serve/batcher.py
+    ``simulate_schedule``), then each mode's realized schedule is
+    REPLAYED inside one scanned program and timed by the same
+    device-trace-preferred slope as every headline — tokens/s =
+    trace tokens (prompt + generated) / (schedule steps × per-step
+    time). Same compiled mixed step, same trace, same bytes: the modes
+    differ only in how many steps the schedule needs, which is exactly
+    the quantity continuous batching exists to shrink.
+
+    ``serve_ttft_ms_p50`` / ``serve_tok_ms_p99`` (+ p99/p50 twins in
+    detail): the REAL host-driven engine loop on the same trace —
+    wall-clock request telemetry including dispatch and scheduling
+    overhead, the serving twin of ``obs_step_ms_p50``'s
+    deliberately-host-side contract (a device slope cannot see queue
+    time).
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_p2p.config import ServeConfig
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.serve.batcher import simulate_schedule
+    from tpu_p2p.serve.engine import run_engine, serve_mesh, synthetic_trace
+    from tpu_p2p.serve.paged_cache import init_paged_pool, make_paged_lm_step
+
+    out = dict(SERVE_NULL)
+    mesh = serve_mesh(1)
+    out["serve_devices"] = 1
+    blocks_worst = -(-(SERVE_PROMPT[1] + SERVE_GEN[1]) // SERVE_PAGE_LEN)
+    sc = ServeConfig(
+        slots=SERVE_SLOTS, page_len=SERVE_PAGE_LEN,
+        num_pages=SERVE_SLOTS * blocks_worst + 1,
+        max_blocks=SERVE_MAX_BLOCKS, chunk=SERVE_CHUNK,
+        requests=SERVE_REQUESTS, seed=0, rate=SERVE_RATE,
+        prompt_len=SERVE_PROMPT, gen_len=SERVE_GEN, vocab=SERVE_VOCAB,
+        dtype=SERVE_DTYPE,
+    )
+    cfg = _serve_model_cfg()
+    trace = synthetic_trace(sc)
+    kw = dict(slots=sc.slots, page_len=sc.page_len,
+              num_pages=sc.num_pages, max_blocks=sc.max_blocks,
+              chunk=sc.chunk)
+    sched = {mode: simulate_schedule(trace, mode=mode, **kw)
+             for mode in ("continuous", "static")}
+    out["serve_steps_continuous"] = sched["continuous"]["steps"]
+    out["serve_steps_static"] = sched["static"]["steps"]
+    tokens = sched["continuous"]["tokens"]
+    out["serve_trace_tokens"] = tokens
+
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    step = make_paged_lm_step(mesh, cfg, page_len=sc.page_len,
+                              max_blocks=sc.max_blocks, chunk=sc.chunk)
+
+    def replay_slope(stacked, n_steps):
+        xs_all = tuple(jnp.asarray(stacked[k]) for k in
+                       ("tokens", "pos", "n_active", "table"))
+
+        @functools.lru_cache(maxsize=None)
+        def make_chain(k):
+            xs = tuple(a[:k] for a in xs_all)
+
+            @jax.jit
+            def f(pool):
+                def body(carry, x):
+                    pool, acc = carry
+                    tk, p, a, tb = x
+                    pool, logits = step(params, pool, tk, p, a, tb)
+                    # Keep the unembed live (scan discards ys, and a
+                    # dead logits einsum would flatter the slope).
+                    acc = acc + logits.astype(jnp.float32).sum()
+                    return (pool, acc), ()
+
+                (pool, acc), _ = jax.lax.scan(
+                    body, (pool, jnp.float32(0.0)), xs)
+                return acc
+
+            return f
+
+        pool = init_paged_pool(cfg, sc.num_pages, sc.page_len, mesh)
+        m = _measure(timing, make_chain, pool, n_steps, repeats=2)
+        return m
+
+    for mode, key in (("continuous", "serve_tokens_per_s"),
+                      ("static", "serve_tokens_per_s_static")):
+        m = replay_slope(sched[mode]["stacked"], sched[mode]["steps"])
+        if m.per_op_s is None:
+            out["serve_error"] = (
+                f"{mode} replay slope was not positive"
+            )
+            continue
+        out[key] = round(tokens / (sched[mode]["steps"] * m.per_op_s))
+        out["serve_source"] = m.source
+    # Request-level wall telemetry off the real host loop (continuous
+    # mode — the mode the engine serves with).
+    s = run_engine(mesh, cfg, params, trace, sc=sc, mode="continuous")
+    for k in ("serve_ttft_ms_p50", "serve_ttft_ms_p99",
+              "serve_tok_ms_p50", "serve_tok_ms_p99"):
+        out[k] = s[k]
+    return out
+
+
 def _decode_chain_slope(timing, max_len: int, iters: int = 512,
                         repeats: int = 6):
     """Shared decode-chain measurement: device-trace slope of a scan
@@ -1221,22 +1391,40 @@ def _decode_chain_slope(timing, max_len: int, iters: int = 512,
     return m, cfg, cache_bytes
 
 
+# Null shape of _decode_metrics — a non-positive slope (or any crash
+# in main()'s guard) publishes these keys with decode_error naming WHY,
+# matching the DMA_NULL/HEALTH_NULL convention. The r12-and-earlier
+# behavior — a bare RuntimeError — left the reason only in stderr and
+# dropped decode_source from the schema on failure rounds.
+DECODE_NULL = {
+    "decode_ms_per_token": None,
+    "decode_tokens_per_s": None,
+    "decode_source": None,
+    "decode_error": None,
+}
+
+
 def _decode_metrics(timing):
     """KV-cached decode tokens/s at a bf16 single-chip config with a
     4k cache and a 1k sliding window (the banded-read fast path) —
     the inference-side number complementing the train-step metric.
     At this cache size the whole working set (params + cache ≈ 53 MB)
-    is VMEM-resident (docs/decode_roofline.md)."""
+    is VMEM-resident (docs/decode_roofline.md). A non-positive
+    differential slope publishes the ``DECODE_NULL`` schema with the
+    reason instead of raising — one bad slope must not drop every
+    decode key from the headline."""
+    out = dict(DECODE_NULL)
     m, cfg, _ = _decode_chain_slope(timing, max_len=4096)
     if m.per_op_s is None:
-        # Raise like _flagship_step_metrics: main() catches and logs,
-        # so a null decode number is explained in stderr.
-        raise RuntimeError("decode differential slope was not positive")
-    return {
+        out["decode_error"] = "differential slope was not positive"
+        print(f"# decode: {out['decode_error']}", file=sys.stderr)
+        return out
+    out.update({
         "decode_ms_per_token": round(m.per_op_s * 1e3, 3),
         "decode_tokens_per_s": round(cfg.batch / m.per_op_s),
         "decode_source": m.source,
-    }
+    })
+    return out
 
 
 def _decode_hbm_metrics(timing, peak_gbytes_per_s):
@@ -1872,8 +2060,8 @@ def main() -> int:
             decode = _decode_metrics(timing)
         except Exception as e:  # noqa: BLE001 — same rationale
             print(f"# decode measurement failed: {e!r}", file=sys.stderr)
-            decode = {"decode_ms_per_token": None,
-                      "decode_tokens_per_s": None}
+            decode = {**DECODE_NULL,
+                      "decode_error": f"{type(e).__name__}: {e}"}
         try:
             decode_hbm = _decode_hbm_metrics(
                 timing, _hbm_peak_for(rt.devices[0].device_kind)[1]
@@ -2007,6 +2195,15 @@ def main() -> int:
         print(f"# health smoke failed: {e!r}", file=sys.stderr)
         health_m = {"health_error": f"{type(e).__name__}: {e}"}
     result["detail"].update({k: health_m.get(k) for k in HEALTH_NULL})
+    # Serving engine (round-13 tentpole): continuous-vs-static paged
+    # serving throughput + request latency tails, SERVE_NULL schema
+    # (with the reason) on failure.
+    try:
+        serve_m = _serve_metrics(timing)
+    except Exception as e:  # noqa: BLE001 — same rationale
+        print(f"# serve measurement failed: {e!r}", file=sys.stderr)
+        serve_m = {"serve_error": f"{type(e).__name__}: {e}"}
+    result["detail"].update({k: serve_m.get(k) for k in SERVE_NULL})
 
     detail_path = _detail_path()
     try:

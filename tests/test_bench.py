@@ -517,6 +517,10 @@ def test_main_single_chip_branch_schema(capsys, monkeypatch, tmp_path):
         bench, "_health_metrics",
         lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
     )
+    monkeypatch.setattr(
+        bench, "_serve_metrics",
+        lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
+    )
     detail_path = os.path.join(str(tmp_path), "BENCH_detail.json")
     monkeypatch.setenv("BENCH_DETAIL_PATH", detail_path)
     rc = bench.main()
@@ -578,6 +582,15 @@ def test_main_single_chip_branch_schema(capsys, monkeypatch, tmp_path):
     assert d["ring_achieved_gbps"] is None
     assert d["ag_achieved_gbps"] is None
     assert d["obs_step_ms_p50"] is None
+    # And the round-13 serve entries — the crash is named in the
+    # SERVE_NULL schema's reason field.
+    assert d["serve_tokens_per_s"] is None
+    assert d["serve_tokens_per_s_static"] is None
+    assert d["serve_ttft_ms_p50"] is None
+    assert "stubbed" in d["serve_error"]
+    # The round-13 decode bugfix: the stubbed crash publishes the
+    # DECODE_NULL schema with the reason, not just bare nulls.
+    assert "stubbed" in d["decode_error"]
     assert "stubbed" in cap.err
     # Latency: a real (cheap, 8-byte) measurement ran — either shape —
     # and every latency dict is discriminated by kind so same-named
@@ -644,6 +657,7 @@ def test_single_chip_headline_vs_baseline_uses_device_kind(capsys,
     monkeypatch.setattr(bench, "_tp_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_obs_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_health_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_serve_metrics", lambda t: {})
     monkeypatch.setattr(
         bench, "_loopback_size_sweep", lambda *a, **kw: [])
     _, r = _run_main(capsys, monkeypatch, tmp_path)
@@ -827,7 +841,6 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         "flagship_large_step_ms": 360.33,
         "flagship_large_mfu": 0.7134,
         "latency_8b_p50_us": 1.2345,
-        "latency_8b_oneop_p50_us": 23.456,
         "fsdp_overlap_frac": 0.8231,
         "fsdp_step_ms_overlap_prefetch": 98.765,
         "tp_overlap_frac": 0.7654,
@@ -837,7 +850,6 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         "pp_overlap_frac": 0.5432,
         "pp_step_ms_overlap_wave": 98.765,
         "ring_achieved_gbps": 1234.56,
-        "ag_achieved_gbps": 987.65,
         "obs_step_ms_p50": 123.456,
         # Round 12: the health trio joined the line; "devices" (the
         # byte-identical twin of the line's own top-level "n") and
@@ -854,10 +866,20 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         "p2p_lat_us_pallas": 98.7654,
         "ring_gbps_xla": 1234.56,
         "ring_gbps_pallas": 1187.43,
+        # Round 13: the serve quartet joined the line;
+        # flagship_large_tokens_per_s (byte-derivable from the step
+        # time), latency_8b_oneop_p50_us (diagnostic companion),
+        # ag_achieved_gbps (ring twin stays; per-link truth lives in
+        # MULTICHIP_r*.json), and decode_hbm_ms_per_token (its
+        # serving-regime-sentinel role passed to the serve keys)
+        # moved to BENCH_detail.json (test_round13_budget_trade pins
+        # the move).
+        "serve_tokens_per_s": 533333,
+        "serve_tokens_per_s_static": 412345,
+        "serve_ttft_ms_p50": 1234.567,
+        "serve_tok_ms_p99": 123.456,
         "flagship_step_ms": 5.96,
         "decode_ms_per_token": 0.123,
-        "decode_hbm_ms_per_token": 0.0419,
-        "flagship_large_tokens_per_s": 45467,
     }
     # Every headline key must have a realistic value in this test —
     # a key added to HEADLINE_KEYS without extending this table would
@@ -907,19 +929,20 @@ def test_obs_metrics_cpu_mesh():
 
 
 def test_obs_headline_keys_survive_compact_budget():
-    # Satellite contract (round 8): the three obs headline keys must
-    # ride the ≤1 KiB compact line at realistic widths — i.e. they are
-    # in HEADLINE_KEYS AND a fully-populated line keeps them (the
+    # Satellite contract (round 8): the obs headline keys must ride
+    # the ≤1 KiB compact line at realistic widths — i.e. they are in
+    # HEADLINE_KEYS AND a fully-populated line keeps them (the
     # general full-schema pin is
     # test_compact_line_fits_with_every_headline_key_at_realistic_width;
     # this asserts the obs keys specifically survive).
-    new = ("ring_achieved_gbps", "ag_achieved_gbps", "obs_step_ms_p50")
+    # ag_achieved_gbps left the line in the round-13 budget trade
+    # (test_round13_budget_trade) — ring stays as the sentinel.
+    new = ("ring_achieved_gbps", "obs_step_ms_p50")
     for k in new:
         assert k in bench.HEADLINE_KEYS, k
     detail = {
         "devices": 256,
         "ring_achieved_gbps": 1234.56,
-        "ag_achieved_gbps": 987.65,
         "obs_step_ms_p50": 123.456,
     }
     result = {
@@ -1012,6 +1035,32 @@ def test_overlap_none_baselines_left_the_compact_line():
                      **bench.EP_NULL, **bench.PP_NULL}, k
 
 
+def test_round13_budget_trade():
+    # The round-13 budget trade, pinned like the round-11 one: four
+    # keys left the compact line for the serve quartet but still
+    # measure into BENCH_detail.json (flagship_large_tokens_per_s in
+    # the flagship_large output, latency_8b_oneop_p50_us in the
+    # one-op schema, ag_achieved_gbps in OBS_NULL,
+    # decode_hbm_ms_per_token in the decode_hbm output). Their gate
+    # tolerances retired WITH them — the driver persists only the
+    # compact line, so a tolerance on a key the line cannot carry
+    # would SKIP forever (the gate's tolerance-⊆-headline rule).
+    from tpu_p2p.obs.regress import TOLERANCES
+
+    gone = ("flagship_large_tokens_per_s", "latency_8b_oneop_p50_us",
+            "ag_achieved_gbps", "decode_hbm_ms_per_token")
+    for k in gone:
+        assert k not in bench.HEADLINE_KEYS, k
+        assert k not in TOLERANCES, k
+    assert "latency_8b_oneop_p50_us" in bench.ONEOP_LATENCY_NULL
+    assert "ag_achieved_gbps" in bench.OBS_NULL
+    for k in ("serve_tokens_per_s", "serve_tokens_per_s_static",
+              "serve_ttft_ms_p50", "serve_tok_ms_p99"):
+        assert k in bench.HEADLINE_KEYS, k
+        assert k in bench.SERVE_NULL, k
+        assert k in TOLERANCES, k
+
+
 # ------------------------------------------------------ health metric
 
 
@@ -1086,3 +1135,88 @@ def test_health_keys_survive_compact_budget():
     head = json.loads(s)["headline"]
     for k in new:
         assert k in head, k
+
+
+# ------------------------------------------------------ serve metric
+
+
+def test_serve_headline_keys_survive_compact_budget():
+    # Satellite contract (round 13): the serve quartet rides the
+    # ≤1 KiB compact line at realistic widths.
+    new = ("serve_tokens_per_s", "serve_tokens_per_s_static",
+           "serve_ttft_ms_p50", "serve_tok_ms_p99")
+    for k in new:
+        assert k in bench.HEADLINE_KEYS, k
+    detail = {
+        "devices": 256,
+        "serve_tokens_per_s": 533333,
+        "serve_tokens_per_s_static": 412345,
+        "serve_ttft_ms_p50": 1234.567,
+        "serve_tok_ms_p99": 123.456,
+    }
+    result = {
+        "metric": "all_pairs_unidir_bandwidth_avg", "value": 1234.567,
+        "unit": "Gbps", "vs_baseline": 0.7716, "detail": detail,
+    }
+    s = bench._compact_line(result, "BENCH_detail.json")
+    assert len(s.encode()) <= bench.COMPACT_LINE_MAX_BYTES
+    head = json.loads(s)["headline"]
+    for k in new:
+        assert k in head, k
+
+
+def test_decode_metrics_null_schema_on_flat_slope(monkeypatch):
+    # The round-13 bugfix: a non-positive differential slope publishes
+    # the DECODE_NULL schema with the reason instead of raising (one
+    # bad slope must not drop every decode key from the headline).
+    class _M:
+        per_op_s = None
+        source = None
+
+    from tpu_p2p.utils import timing
+
+    monkeypatch.setattr(bench, "_decode_chain_slope",
+                        lambda t, max_len, iters=512, repeats=6:
+                        (_M(), None, 0))
+    out = bench._decode_metrics(timing)
+    assert set(out) == set(bench.DECODE_NULL)
+    assert out["decode_ms_per_token"] is None
+    assert out["decode_tokens_per_s"] is None
+    assert out["decode_source"] is None
+    assert "slope" in out["decode_error"]
+
+
+@pytest.mark.slow  # tier-1 budget (~60 s: real scheduler simulation +
+# two scanned replay compiles + a host engine run on the CPU mesh,
+# shrunk from the graded TPU shape via the module constants). The
+# wiring stays tier-1-covered by the stubbed main() twins and the
+# budget/trade pins above.
+def test_serve_metrics_cpu_mesh(monkeypatch):
+    from tpu_p2p.utils import timing
+
+    # Graded shape is TPU-scale (32 slots, 2048 vocab, 48 requests);
+    # shrink for the simulated mesh — the code path is identical.
+    monkeypatch.setattr(bench, "SERVE_SLOTS", 4)
+    monkeypatch.setattr(bench, "SERVE_PAGE_LEN", 8)
+    monkeypatch.setattr(bench, "SERVE_MAX_BLOCKS", 4)
+    monkeypatch.setattr(bench, "SERVE_CHUNK", 4)
+    monkeypatch.setattr(bench, "SERVE_REQUESTS", 8)
+    monkeypatch.setattr(bench, "SERVE_RATE", 1.0)
+    monkeypatch.setattr(bench, "SERVE_PROMPT", (4, 12))
+    monkeypatch.setattr(bench, "SERVE_GEN", (4, 8))
+    monkeypatch.setattr(bench, "SERVE_VOCAB", 64)
+    monkeypatch.setattr(bench, "SERVE_DTYPE", "float32")
+    out = bench._serve_metrics(timing)
+    assert set(out) == set(bench.SERVE_NULL)
+    assert out["serve_devices"] == 1
+    assert out["serve_error"] is None
+    assert out["serve_tokens_per_s"] > 0
+    assert out["serve_tokens_per_s_static"] > 0
+    # The A/B: same trace, same tokens, fewer continuous steps — so
+    # continuous tokens/s wins (per-step cost is the same program).
+    assert out["serve_steps_continuous"] < out["serve_steps_static"]
+    assert out["serve_tokens_per_s"] > out["serve_tokens_per_s_static"]
+    assert out["serve_trace_tokens"] > 0
+    assert out["serve_ttft_ms_p50"] is not None
+    assert out["serve_tok_ms_p99"] is not None
+    assert out["serve_source"] in ("device_trace", "host_differential")

@@ -81,6 +81,14 @@ SUMMARY_PATTERNS = {
     # criterion) or _run_cli fails the returncode assert.
     "obs": ["obs", "--cpu-mesh", "8", "--msg-size", "256KiB",
             "--count", "4", "--current", "BENCH_r05.json"],
+    # The round-13 serve subcommand end to end on the 8-device mesh:
+    # the paged-cache + continuous-batching engine over a seeded
+    # Poisson trace, continuous-vs-static A/B on the same requests.
+    # Request/step/token counts are schedule-deterministic (arrivals
+    # are step-indexed, greedy tokens never change lengths) and stay
+    # pinned; every wall-derived rate/latency magnitude masks.
+    "serve": ["serve", "--cpu-mesh", "8", "--requests", "6",
+              "--seed", "0", "--batching", "both"],
     # The round-12 watch subcommand end to end over a checked-in
     # deterministic obs stream (tests/golden/obs_watch_fixture.jsonl):
     # one embedded health verdict re-printed + one straggler re-scored

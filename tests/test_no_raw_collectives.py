@@ -33,7 +33,11 @@ _RAW_CALL = re.compile(
 )
 
 # The trees the ledger cannot see into unless they use the wrappers.
-SCANNED = ("models", "ops")
+# Round 13 added serve/ — the paged decode step issues the same tp
+# psum joins and ep all_to_alls as the dense one, and a raw collective
+# there would leak serving transport past the ledger exactly like the
+# round-9 moe.py hole.
+SCANNED = ("models", "ops", "serve")
 
 
 def _py_files():
@@ -82,7 +86,12 @@ def test_lint_scans_the_expected_trees():
     files = list(_py_files())
     names = {os.path.basename(p) for p in files}
     assert "moe.py" in names and "attention.py" in names, sorted(names)
-    assert len(files) >= 15, files
+    # The round-13 serve tree is covered (paged_cache.py issues the
+    # decode psum joins through the wrappers; a regression that drops
+    # serve/ from SCANNED must fail here, not ship silently).
+    assert "paged_cache.py" in names and "batcher.py" in names, \
+        sorted(names)
+    assert len(files) >= 18, files
 
 
 # ---------------------------------------------------- pallas transport

@@ -296,6 +296,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_p2p.obs.regress import main as obs_main
 
         return obs_main(list(argv[1:]))
+    if argv and argv[0] == "serve":
+        # ``python -m tpu_p2p serve`` — the serving engine smoke:
+        # paged KV cache + continuous batching over a synthetic
+        # Poisson request trace (tpu_p2p/serve/, docs/serving.md).
+        # Dispatched like obs: its own flag set.
+        from tpu_p2p.serve.engine import main as serve_main
+
+        return serve_main(list(argv[1:]))
     args = build_parser().parse_args(argv)
     try:
         if args.cpu_mesh:

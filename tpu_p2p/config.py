@@ -64,6 +64,23 @@ def parse_edge(text: str) -> Tuple[int, int]:
     return src, dst
 
 
+def parse_range(text: str) -> Tuple[int, int]:
+    """Parse ``'4:12'`` → the inclusive integer range ``(4, 12)`` —
+    the CLI spelling of the serve trace's prompt/output length ranges
+    (``python -m tpu_p2p serve --prompt-len``, docs/serving.md)."""
+    parts = str(text).split(":")
+    try:
+        lo, hi = (int(p) for p in parts)
+        if lo < 1 or hi < lo:
+            raise ValueError("empty or non-positive range")
+    except ValueError:
+        raise ValueError(
+            f"unparseable range {text!r}; expected LO:HI with "
+            "1 <= LO <= HI, e.g. 4:12"
+        ) from None
+    return lo, hi
+
+
 def parse_sweep(text: str) -> Tuple[int, ...]:
     """``'1KiB:1GiB'`` → powers-of-two sweep; ``'4KB,32MiB'`` → list."""
     if ":" in text:
@@ -237,3 +254,73 @@ class BenchConfig:
         d = {f.name: getattr(self, f.name) for f in fields(self)}
         d.update(kw)
         return BenchConfig(**d)
+
+
+BATCHING = ("continuous", "static", "both")
+# Serving-engine batching modes (docs/serving.md): continuous = slots
+# refilled from the queue the step a sequence finishes; static = the
+# run-to-completion baseline (the batch refills only when every slot
+# drained — the A/B bench grades); both = run the A/B on one trace.
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Everything one serving run needs (tpu_p2p/serve/engine.py):
+    the paged-cache geometry, the slot batch, and the synthetic
+    trace. Mesh-dependent divisibility (slots / num_pages over the
+    dp×ep shard count) is validated where the mesh exists — in the
+    batcher/pool constructors."""
+
+    slots: int = 8            # fixed-width slot batch
+    page_len: int = 8         # tokens per KV page (multiple of 8 —
+    # the band-write granularity, ops/kvcache.paged_rows_write)
+    num_pages: int = 64       # global page-pool size (incl. each
+    # shard's reserved trash page)
+    max_blocks: int = 8       # page-table width = the attention
+    # window in pages (max_blocks * page_len positions)
+    chunk: int = 4            # prefill chunk width per step (1/2/4/8:
+    # multi-token chunks must stay inside one 8-row write band)
+    batching: str = "continuous"
+    requests: int = 8         # synthetic trace length
+    seed: int = 0
+    rate: float = 1.0         # mean Poisson arrivals per scheduler step
+    prompt_len: Tuple[int, int] = (4, 12)   # inclusive
+    gen_len: Tuple[int, int] = (4, 8)       # inclusive
+    vocab: int = 128
+    dtype: str = "float32"
+
+    def __post_init__(self) -> None:
+        if self.page_len <= 0 or self.page_len % 8:
+            raise ValueError(
+                f"page_len must be a positive multiple of 8, got "
+                f"{self.page_len}"
+            )
+        if self.chunk not in (1, 2, 4, 8):
+            raise ValueError(
+                f"chunk must be one of 1/2/4/8, got {self.chunk}"
+            )
+        if self.batching not in BATCHING:
+            raise ValueError(
+                f"unknown batching {self.batching!r}; expected one of "
+                f"{BATCHING}"
+            )
+        for name in ("slots", "num_pages", "max_blocks", "requests",
+                     "vocab"):
+            if getattr(self, name) <= 0:
+                raise ValueError(f"{name} must be positive")
+        if self.rate <= 0:
+            raise ValueError(f"rate must be positive, got {self.rate}")
+        for name in ("prompt_len", "gen_len"):
+            lo, hi = getattr(self, name)
+            if lo < 1 or hi < lo:
+                raise ValueError(
+                    f"{name} must be an inclusive 1 <= LO <= HI "
+                    f"range, got {(lo, hi)}"
+                )
+        window = self.max_blocks * self.page_len
+        need = self.prompt_len[1] + self.gen_len[1]
+        if need > window:
+            raise ValueError(
+                f"worst-case request ({need} tokens) overruns the "
+                f"max_blocks*page_len window ({window})"
+            )

@@ -103,6 +103,51 @@ def init_kv_cache(cfg: FlagshipConfig, max_len: int, mesh: Mesh) -> Cache:
     return {"k": zeros(), "v": zeros()}
 
 
+def _attend_ffn(sub, x, q, kb, vb, live, cfg, tp, ep):
+    """The per-layer cached-attention tail — ONE definition compiled by
+    both the dense decode step and the paged serving step
+    (:mod:`tpu_p2p.serve.paged_cache`).
+
+    ``x``: residual stream ``[B_loc, C, Dm]`` (``C = 1`` for the dense
+    token step, the prefill chunk width for the paged mixed step);
+    ``q``: already-roped queries ``[B_loc, H_loc, C, Dh]``;
+    ``kb``/``vb``: the KV band to attend over ``[B_loc, H_kv_loc, T,
+    Dh]`` — the dense cache's (windowed) band or the page-gathered
+    view; ``live``: boolean mask broadcastable to the score shape
+    ``[B, H_kv, group, C, T]`` (masked keys score NEG_INF, which
+    underflows to an exact 0 weight — so garbage in dead cache slots /
+    unwritten pages cannot reach the output). Applies the
+    grouped-query contraction, the Megatron out-projection psum join,
+    the residual, and the FFN (dense or MoE).
+    """
+    from tpu_p2p.models.flagship import _dense_ffn, _rms_norm
+
+    b, hq, c = q.shape[0], q.shape[1], q.shape[2]
+    # Grouped-query contraction straight against the narrow KV band —
+    # no materialized repeat_kv widening (group == 1 is plain MHA).
+    group = hq // kb.shape[1]
+    qg = q.reshape(b, kb.shape[1], group, c, cfg.head_dim)
+    s = jnp.einsum("bkgtd,bkTd->bkgtT", qg, kb,
+                   preferred_element_type=jnp.float32)
+    s = s / (cfg.head_dim ** 0.5)
+    s = jnp.where(live, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+    a = jnp.einsum("bkgtT,bkTd->bkgtd", p, vb,
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    a = a.reshape(b, hq, c, cfg.head_dim)
+    y = jnp.einsum("bhtd,hdm->btm", a, sub["wo"])
+    if tp is not None:
+        y = C.psum(y, tp, label="megatron_attn_join")
+    x = x + y
+    h2 = _rms_norm(x, sub["ln2"]) if cfg.norm else x
+    if cfg.dense_ffn:
+        return x + _dense_ffn(sub, h2, tp)
+    moe_params = {"router": sub["router"], "w1": sub["we1"], "w2": sub["we2"]}
+    tokens = h2.reshape(-1, h2.shape[-1])
+    m_out = moe_layer_local(moe_params, tokens, cfg.moe(), ep_axis=ep)
+    return x + m_out.reshape(x.shape)
+
+
 def _decode_sub_block(sub, x, h, k_cache, v_cache, pos, cfg, tp, ep):
     """One transformer block on a single token, against the cache.
 
@@ -110,18 +155,16 @@ def _decode_sub_block(sub, x, h, k_cache, v_cache, pos, cfg, tp, ep):
     twin (``== x`` when ``cfg.norm`` is off), computed once in
     :func:`_decode_stack` and shared with the k/v projections there.
     ``k_cache``/``v_cache``: ``[B_loc, H_kv_loc, max_len, Dh]`` already
-    holding this step's K/V at ``pos``. Mirrors
-    flagship._stage_sub_block's math.
+    holding this step's K/V at ``pos``. Selects the dense cache's
+    (windowed) band + live mask; the attention/FFN math is the shared
+    :func:`_attend_ffn` body.
     """
-    from tpu_p2p.models.flagship import _dense_ffn, _rms_norm
-
     max_len = k_cache.shape[2]
     q = jnp.einsum("btm,hmd->bhtd", h, sub["wq"])     # [B, H, 1, Dh]
     if cfg.rope:
         from tpu_p2p.ops.rope import apply_rope
 
         q = apply_rope(q, jnp.reshape(pos, (1,)))
-    b, hq = q.shape[0], q.shape[1]
     w = cfg.attn_window
     if w and w < max_len:
         # Sliding window: read only the live band of the cache —
@@ -141,29 +184,8 @@ def _decode_sub_block(sub, x, h, k_cache, v_cache, pos, cfg, tp, ep):
         live = band_pos <= pos
         if w:
             live &= band_pos > pos - w
-    # Grouped-query contraction straight against the narrow KV band —
-    # no materialized repeat_kv widening (group == 1 is plain MHA).
-    group = hq // kb.shape[1]
-    qg = q.reshape(b, kb.shape[1], group, 1, cfg.head_dim)
-    s = jnp.einsum("bkgtd,bkTd->bkgtT", qg, kb,
-                   preferred_element_type=jnp.float32)
-    s = s / (cfg.head_dim ** 0.5)
-    s = jnp.where(live[None, None, None, None, :], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-    a = jnp.einsum("bkgtT,bkTd->bkgtd", p, vb,
-                   preferred_element_type=jnp.float32).astype(x.dtype)
-    a = a.reshape(b, hq, 1, cfg.head_dim)
-    y = jnp.einsum("bhtd,hdm->btm", a, sub["wo"])
-    if tp is not None:
-        y = C.psum(y, tp, label="megatron_attn_join")
-    x = x + y
-    h2 = _rms_norm(x, sub["ln2"]) if cfg.norm else x
-    if cfg.dense_ffn:
-        return x + _dense_ffn(sub, h2, tp)
-    moe_params = {"router": sub["router"], "w1": sub["we1"], "w2": sub["we2"]}
-    tokens = h2.reshape(-1, h2.shape[-1])
-    m_out = moe_layer_local(moe_params, tokens, cfg.moe(), ep_axis=ep)
-    return x + m_out.reshape(x.shape)
+    return _attend_ffn(sub, x, q, kb, vb,
+                       live[None, None, None, None, :], cfg, tp, ep)
 
 
 def _decode_stack(params, cache: Cache, x, pos, cfg, tp, ep):

@@ -192,6 +192,24 @@ class CollectiveLedger:
         return out
 
 
+def totals_record(ledger: CollectiveLedger) -> dict:
+    """One JSON-ready ``{"obs": "serve_ledger"}`` totals row for an
+    ``--obs-jsonl`` stream — the serving engine's transport receipt
+    (tpu_p2p/serve/engine.py traces its mixed step under
+    :func:`recording`, so the tp psum joins and ep reshards land here
+    through the same instrumented wrappers as a training step's; a
+    collective the ledger cannot see would be the grep-lint violation
+    tests/test_no_raw_collectives.py flags)."""
+    return {
+        "obs": "serve_ledger",
+        "issues": len(ledger),
+        "totals": {
+            f"{kind}/{axis}": dict(tot)
+            for (kind, axis), tot in sorted(ledger.totals().items())
+        },
+    }
+
+
 # Stack, not a single slot: nested `recording()` scopes each see the
 # issues recorded inside them (an outer run-level ledger and an inner
 # per-step one both get the entry).

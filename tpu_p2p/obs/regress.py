@@ -70,14 +70,19 @@ TOLERANCES: Dict[str, Tolerance] = {
     "hbm_gbytes_per_s": Tolerance("higher", 0.15),
     "flash_attention_tflops": Tolerance("higher", 0.15),
     "flash_bwd_tflops": Tolerance("higher", 0.15),
+    # Round 13 retired four tolerances with their compact-line keys
+    # (flagship_large_tokens_per_s, latency_8b_oneop_p50_us,
+    # ag_achieved_gbps, decode_hbm_ms_per_token — see the
+    # HEADLINE_KEYS budget-trade note in bench.py): the driver
+    # persists only the compact line, so a tolerance on a key the
+    # line no longer carries would SKIP forever — dead config by this
+    # module's own rule (tests/test_obs_regress.py pins tolerance ⊆
+    # headline). The values still measure into BENCH_detail.json.
     "flagship_step_ms": Tolerance("lower", 0.20),
     "flagship_large_step_ms": Tolerance("lower", 0.15),
     "flagship_large_mfu": Tolerance("higher", 0.10),
-    "flagship_large_tokens_per_s": Tolerance("higher", 0.15),
     "latency_8b_p50_us": Tolerance("lower", 0.50),
-    "latency_8b_oneop_p50_us": Tolerance("lower", 0.50),
     "decode_ms_per_token": Tolerance("lower", 0.25),
-    "decode_hbm_ms_per_token": Tolerance("lower", 0.20),
     "fsdp_overlap_frac": Tolerance("higher", 0.25),
     "fsdp_step_ms_overlap_prefetch": Tolerance("lower", 0.25),
     "tp_overlap_frac": Tolerance("higher", 0.25),
@@ -89,7 +94,6 @@ TOLERANCES: Dict[str, Tolerance] = {
     "pp_step_ms_overlap_wave": Tolerance("lower", 0.25),
     # PR 3 obs keys (bench.py _obs_metrics).
     "ring_achieved_gbps": Tolerance("higher", 0.25),
-    "ag_achieved_gbps": Tolerance("higher", 0.25),
     "obs_step_ms_p50": Tolerance("lower", 0.30),
     # PR 6 dma-transport keys (bench.py _dma_transport_metrics): the
     # XLA-vs-Pallas p2p head-to-head. Latency floors are the
@@ -110,6 +114,15 @@ TOLERANCES: Dict[str, Tolerance] = {
     "obs_step_ms_p99": Tolerance("lower", 0.50),
     "health_detect_steps": Tolerance("lower", 1.00),
     "heal_resume_loss_delta": Tolerance("lower", 1.00, abs_floor=0.05),
+    # PR 8 serving-engine keys (bench.py _serve_metrics). The two
+    # tokens/s numbers ride the device-trace replay slope (25%, like
+    # the achieved-Gbps family); the request-latency tails ride the
+    # real host loop — the jitteriest family (50%, like the 8 B
+    # latency floors and obs_step_ms_p99).
+    "serve_tokens_per_s": Tolerance("higher", 0.25),
+    "serve_tokens_per_s_static": Tolerance("higher", 0.25),
+    "serve_ttft_ms_p50": Tolerance("lower", 0.50),
+    "serve_tok_ms_p99": Tolerance("lower", 0.50),
 }
 
 _TAIL_KV = re.compile(

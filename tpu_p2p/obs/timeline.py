@@ -19,6 +19,15 @@ lives in the trace join instead (:mod:`tpu_p2p.obs.ledger` per-kind
 collective time, ``profiling.op_category_breakdown`` compute
 categories) — measured where it happens, not guessed from the host.
 
+The stream's record vocabulary is open the same way the span set is:
+the trainer emits ``{"obs": "step" | "device_window" | "summary"}``
+(plus the health engine's ``"health"`` / ``"heal"`` verdicts,
+docs/health.md), and the round-13 serving engine emits
+``{"obs": "request" | "serve_summary" | "serve_ledger"}`` per-request
+span records into the same file (docs/serving.md trace schema) —
+consumers must skip kinds they do not know, which is how ``obs
+watch`` already treats non-health records.
+
 Device correlation: :func:`device_window_record` turns one sampled
 ``jax.profiler.trace`` capture of a step into a
 ``{"obs": "device_window"}`` record carrying the device-busy

@@ -1,4 +1,4 @@
-"""Aliased-Pallas KV-cache band write — the decode roofline lever.
+"""Aliased-Pallas KV-cache band writes — the decode roofline lever.
 
 Moved here from ``models/decode.py`` in round 11: the pallas-transport
 lint (tests/test_no_raw_collectives.py) confines every
@@ -6,6 +6,14 @@ lint (tests/test_no_raw_collectives.py) confines every
 kernels stay in the instrumented/kernel layers; this is the one model-
 layer kernel that predated the rule. Semantics and measured numbers
 are unchanged (docs/decode_roofline.md).
+
+Round 13 adds the paged twin (:func:`paged_rows_write`): the serving
+engine's KV pool is ``[stages, num_pages, H_kv, page_len, Dh]`` and
+each batch slot writes its token rows into ITS page — so the band
+index map takes a per-slot **page index** (scalar-prefetched) instead
+of the dense cache's stage-static row, and one grid step per slot
+replaces the dense kernel's single band. Same aliasing contract, same
+8-row TPU block granularity, same DUS fallback conditions.
 """
 
 from __future__ import annotations
@@ -76,3 +84,103 @@ def cache_row_write(cache, slab, pos, stage: int):
         input_output_aliases={2: 0},
         interpret=jax.default_backend() == "cpu",
     )(scalars, slab, cache)
+
+
+def _paged_band_kernel(scal_ref, slab_ref, band_in_ref, band_ref):
+    """Write one slot's token rows inside an 8-row band of its page.
+
+    Grid step ``i`` = batch slot ``i``; ``scal_ref[i]`` = (page index —
+    consumed by the index maps, band within page — likewise, first row
+    within band, row count). The band is read, rows ``[r0, r0 + n)``
+    replaced from the pre-placed slab, the band written back — the
+    paged twin of :func:`_cache_row_kernel`. ``n = 0`` (an idle slot
+    parked on the trash page) writes the band back unchanged."""
+    from jax.experimental import pallas as pl
+
+    r0 = scal_ref[pl.program_id(0), 2]
+    n = scal_ref[pl.program_id(0), 3]
+    band = band_in_ref[...]
+    rows = jax.lax.broadcasted_iota(jnp.int32, band.shape, 3)
+    sel = (rows >= r0) & (rows < r0 + n)
+    band_ref[...] = jnp.where(sel, slab_ref[...][None], band)
+
+
+def paged_rows_write(pool, slab8, page_ids, band_ids, r0, n, stage: int,
+                     pallas=None):
+    """In-place write of each slot's token rows into its page of
+    ``pool [stages, num_pages, H, page_len, Dh]`` — the paged-cache
+    counterpart of :func:`cache_row_write`.
+
+    ``slab8 [B, H, 8, Dh]``: per-slot band image with the slot's
+    ``n[b]`` real rows already placed at rows ``r0[b] .. r0[b]+n[b]-1``
+    (rows outside that range are ignored — the select keeps the
+    resident band there). ``page_ids``/``band_ids``/``r0``/``n``:
+    per-slot int32 vectors; the caller guarantees each slot's row
+    range stays inside one 8-row band (the batcher aligns prefill
+    chunks to the band granularity, and single-token decode writes
+    trivially satisfy it). Slots with ``n == 0`` must carry the trash
+    page so the no-op write touches no live page.
+
+    ``page_len`` must be a multiple of the 8-row band granularity (the
+    band decomposition the whole paged layout is built on —
+    :func:`tpu_p2p.serve.paged_cache.init_paged_pool` validates the
+    same constraint at allocation time). Aliased-Pallas fast path
+    except on the interpret (CPU) backend under shard_map vma — there
+    a read-modify-write DUS fallback per slot does an 8-row band round
+    trip, never a whole-pool rewrite of unselected rows (``pallas`` is
+    a testing override: True/False forces a path, None auto-detects
+    like :func:`cache_row_write`)."""
+    s_, p_, h, plen, dh = pool.shape
+    b = slab8.shape[0]
+    if plen % 8:
+        raise ValueError(
+            f"page_len ({plen}) must be a multiple of the 8-row band "
+            "granularity"
+        )
+    from tpu_p2p.ops.attention import _vma_of
+
+    if pallas is None:
+        pallas = not (jax.default_backend() == "cpu" and _vma_of(pool))
+    slab8 = slab8.astype(pool.dtype)
+    if not pallas:
+        rows = jnp.arange(8, dtype=jnp.int32)
+        for i in range(b):
+            start = band_ids[i] * 8
+            band = jax.lax.dynamic_slice(
+                pool, (stage, page_ids[i], 0, start, 0),
+                (1, 1, h, 8, dh))
+            sel = (rows >= r0[i]) & (rows < r0[i] + n[i])
+            band = jnp.where(sel[None, None, None, :, None],
+                             slab8[i][None, None], band)
+            pool = jax.lax.dynamic_update_slice(
+                pool, band, (stage, page_ids[i], 0, start, 0))
+        return pool
+
+    from jax.experimental import pallas as pl  # noqa: F401 — kernel
+    from jax.experimental.pallas import tpu as pltpu
+
+    from tpu_p2p.ops.attention import _union_vma
+
+    scalars = jnp.stack(
+        [page_ids, band_ids, r0, n], axis=1).astype(jnp.int32)
+    vma, (scalars, slab8, pool) = _union_vma(scalars, slab8, pool)
+    return pl.pallas_call(
+        _paged_band_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b,),
+            in_specs=[
+                pl.BlockSpec((1, h, 8, dh),
+                             lambda i, s: (i, 0, 0, 0)),
+                pl.BlockSpec((1, 1, h, 8, dh),
+                             lambda i, s, st=stage:
+                             (st, s[i, 0], 0, s[i, 1], 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, h, 8, dh),
+                lambda i, s, st=stage: (st, s[i, 0], 0, s[i, 1], 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct(pool.shape, pool.dtype, vma=vma),
+        input_output_aliases={2: 0},
+        interpret=jax.default_backend() == "cpu",
+    )(scalars, slab8, pool)

@@ -1,0 +1,51 @@
+"""Serving engine — the millions-of-users layer over decode.
+
+Three layers (ROADMAP "production serving engine", docs/serving.md):
+
+- :mod:`tpu_p2p.serve.paged_cache` — the paged KV cache: a pool of
+  fixed-size pages per stage plus per-request page tables, a
+  host-side free-list allocator, and the one compiled mixed
+  prefill/decode step that attends through page gathers.
+- :mod:`tpu_p2p.serve.batcher` — continuous batching over a
+  fixed-width slot batch: every slot independently mid-prefill
+  (chunked) or mid-decode, refilled from the queue the step a
+  sequence finishes.
+- :mod:`tpu_p2p.serve.engine` — the request scheduler + CLI
+  (``python -m tpu_p2p serve``): synthetic Poisson traces, per-request
+  spans into the ``--obs-jsonl`` timeline, and the aggregate
+  tokens/s + TTFT/per-token latency summary bench grades.
+"""
+
+from tpu_p2p.serve.paged_cache import (  # noqa: F401
+    OutOfPages,
+    PagePool,
+    TRASH_PAGE,
+    init_paged_pool,
+    make_paged_lm_step,
+    paged_pool_spec,
+)
+from tpu_p2p.serve.batcher import (  # noqa: F401
+    Batcher,
+    Request,
+    simulate_schedule,
+)
+from tpu_p2p.serve.engine import (  # noqa: F401
+    run_engine,
+    serve_mesh,
+    synthetic_trace,
+)
+
+__all__ = [
+    "Batcher",
+    "OutOfPages",
+    "PagePool",
+    "Request",
+    "TRASH_PAGE",
+    "init_paged_pool",
+    "make_paged_lm_step",
+    "paged_pool_spec",
+    "run_engine",
+    "serve_mesh",
+    "simulate_schedule",
+    "synthetic_trace",
+]

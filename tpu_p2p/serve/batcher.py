@@ -1,0 +1,336 @@
+"""Continuous batcher — slot lifecycle over the compiled mixed step.
+
+One fixed-width slot batch, one compiled program
+(:func:`tpu_p2p.serve.paged_cache.make_paged_lm_step`), every step:
+each slot is independently **mid-prefill** (consuming its prompt in
+``chunk``-token slices, so a long prompt never stalls the other
+slots' decodes), **mid-decode** (one generated token per step), or
+**idle**. Under ``mode="continuous"`` a finishing sequence's slot is
+refilled from the queue the very same step — no run-to-completion
+barrier; ``mode="static"`` is the A/B baseline: the batch refills
+only when EVERY slot has drained (the classic static-batching
+convention whose tail slots idle while the longest sequence
+finishes).
+
+Scheduling is length-driven only — greedy token VALUES never alter
+slot occupancy (no early-exit token in the synthetic traces) — which
+is what makes :func:`simulate_schedule` exact: the whole per-step
+input sequence (tokens/pos/n_active/tables) can be computed without
+touching a device, replayed later inside one scanned program for the
+bench's device-trace throughput slope, and compared across batching
+modes step-for-step (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from tpu_p2p.serve.paged_cache import (
+    OutOfPages,
+    PagePool,
+    TRASH_PAGE,
+    init_paged_pool,
+    make_paged_lm_step,
+    pool_shards,
+)
+
+BATCHING_MODES = ("continuous", "static")
+
+
+@dataclasses.dataclass
+class Request:
+    """One sequence to serve: prompt ids in, ``max_new`` greedy ids
+    out. ``arrival_step`` indexes the batcher's step counter (NOT wall
+    time) so traces schedule deterministically; wall timestamps are
+    recorded as the lifecycle events actually happen."""
+
+    rid: int
+    prompt: np.ndarray          # int32 [P], P >= 1
+    max_new: int                # >= 1 generated tokens
+    arrival_step: int = 0
+    # Lifecycle (filled by the batcher; steps are exact/deterministic,
+    # wall times carry the host loop's real latency).
+    enqueue_step: Optional[int] = None
+    prefill_start_step: Optional[int] = None
+    first_token_step: Optional[int] = None
+    finish_step: Optional[int] = None
+    t_enqueue: Optional[float] = None
+    t_prefill_start: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_finish: Optional[float] = None
+    generated: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def n_prompt(self) -> int:
+        return int(len(self.prompt))
+
+    def blocks_needed(self, page_len: int) -> int:
+        return -(-(self.n_prompt + self.max_new) // page_len)
+
+
+class _Slot:
+    __slots__ = ("req", "pos", "phase", "pages")
+
+    def __init__(self, req: Request, pages: List[int]) -> None:
+        self.req = req
+        self.pos = 0            # tokens already resident in the cache
+        self.phase = "prefill"
+        self.pages = pages
+
+
+class Batcher:
+    """Slot state + queue over the mixed step. ``dry=True`` builds no
+    device program and records the schedule instead (tokens for
+    not-yet-generated positions are 0 — cost-identical for replay,
+    value-irrelevant for scheduling)."""
+
+    def __init__(self, mesh, cfg, params, *, slots: int, page_len: int,
+                 num_pages: int, max_blocks: int, chunk: int,
+                 mode: str = "continuous", dry: bool = False,
+                 n_shards: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if mode not in BATCHING_MODES:
+            raise ValueError(
+                f"unknown batching mode {mode!r}; expected one of "
+                f"{BATCHING_MODES}"
+            )
+        if n_shards is None:
+            n_shards = pool_shards(mesh) if mesh is not None else 1
+        if slots % n_shards:
+            raise ValueError(
+                f"slots ({slots}) must divide by the dp×ep shard "
+                f"count ({n_shards})"
+            )
+        self.mesh, self.cfg, self.params = mesh, cfg, params
+        self.slots_n = slots
+        self.page_len, self.max_blocks = page_len, max_blocks
+        self.chunk, self.mode, self.dry = chunk, mode, dry
+        self.n_shards = n_shards
+        self.clock = clock
+        self.pool_alloc = PagePool(num_pages, page_len, n_shards)
+        self.queue: deque = deque()
+        self.slots: List[Optional[_Slot]] = [None] * slots
+        self.tables = np.zeros((slots, max_blocks), np.int32)
+        self.step_idx = 0
+        self.idle_steps = 0
+        self.finished: List[Request] = []
+        self.schedule: List[Dict[str, np.ndarray]] = [] if dry else None
+        if not dry:
+            self._step = make_paged_lm_step(
+                mesh, cfg, page_len=page_len, max_blocks=max_blocks,
+                chunk=chunk)
+            self.pool = init_paged_pool(cfg, num_pages, page_len, mesh)
+        else:
+            self._step, self.pool = None, None
+
+    # ------------------------------------------------------ scheduling
+
+    def _shard_of(self, slot: int) -> int:
+        return slot // (self.slots_n // self.n_shards)
+
+    def submit(self, req: Request) -> None:
+        req.enqueue_step = self.step_idx
+        req.t_enqueue = self.clock()
+        self.queue.append(req)
+
+    def idle(self) -> bool:
+        return not self.queue and all(s is None for s in self.slots)
+
+    def _admit(self) -> None:
+        if self.mode == "static" and any(s is not None
+                                         for s in self.slots):
+            return  # run-to-completion barrier: drain first
+        for i in range(self.slots_n):
+            if not self.queue:
+                return
+            if self.slots[i] is not None:
+                continue
+            req = self.queue[0]
+            blocks = req.blocks_needed(self.page_len)
+            if blocks > self.max_blocks:
+                raise ValueError(
+                    f"request {req.rid}: {blocks} blocks exceed the "
+                    f"step's max_blocks={self.max_blocks} window"
+                )
+            if blocks > self.pool_alloc.capacity:
+                raise ValueError(
+                    f"request {req.rid}: needs {blocks} pages but a "
+                    f"shard owns only {self.pool_alloc.capacity} — "
+                    "it could never be admitted"
+                )
+            shard = self._shard_of(i)
+            try:
+                pages = self.pool_alloc.alloc_n(blocks, shard)
+            except OutOfPages:
+                # Head-of-line request does not fit THIS shard's pool;
+                # another free slot may live on a shard with pages.
+                continue
+            self.queue.popleft()
+            self.slots[i] = _Slot(req, pages)
+            row = np.full(self.max_blocks, TRASH_PAGE, np.int32)
+            row[:blocks] = pages
+            self.tables[i] = row
+
+    def _build_inputs(self):
+        c = self.chunk
+        tokens = np.zeros((self.slots_n, c), np.int32)
+        pos = np.zeros(self.slots_n, np.int32)
+        n_active = np.zeros(self.slots_n, np.int32)
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            req = s.req
+            pos[i] = s.pos
+            if s.phase == "prefill":
+                n = min(c, req.n_prompt - s.pos)
+                tokens[i, :n] = req.prompt[s.pos:s.pos + n]
+                n_active[i] = n
+            else:
+                tokens[i, 0] = req.generated[-1]
+                n_active[i] = 1
+        return tokens, pos, n_active
+
+    # ------------------------------------------------------- stepping
+
+    def step(self) -> List[Request]:
+        """Admit, run one mixed step, advance every slot; → requests
+        that finished this step (their pages already freed)."""
+        self._admit()
+        tokens, pos, n_active = self._build_inputs()
+        if not int(n_active.sum()):
+            # Nothing resident: a pure idle tick (the engine advances
+            # the step counter while waiting on arrivals); idle ticks
+            # never enter the replay schedule — both modes idle
+            # identically on the same arrival gaps.
+            self.idle_steps += 1
+            self.step_idx += 1
+            return []
+        now = self.clock()
+        for s in self.slots:
+            if s is not None and s.phase == "prefill" and s.pos == 0 \
+                    and s.req.t_prefill_start is None:
+                s.req.t_prefill_start = now
+                s.req.prefill_start_step = self.step_idx
+        if self.dry:
+            self.schedule.append({
+                "tokens": tokens, "pos": pos, "n_active": n_active,
+                "table": self.tables.copy(),
+            })
+            logits = None
+        else:
+            import jax
+
+            self.pool, logits = self._step(
+                self.params, self.pool,
+                *self._place(tokens, pos, n_active))
+            logits = np.asarray(jax.device_get(logits))
+        done: List[Request] = []
+        now = self.clock()
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            req, n = s.req, int(n_active[i])
+            s.pos += n
+            emitted = None
+            if s.phase == "prefill" and s.pos >= req.n_prompt:
+                s.phase = "decode"
+                emitted = n - 1       # last prompt row's logits
+            elif s.phase == "decode":
+                emitted = 0
+            if emitted is not None:
+                tok = (int(np.argmax(logits[i, emitted]))
+                       if logits is not None else 0)
+                if not req.generated:
+                    req.t_first_token = now
+                    req.first_token_step = self.step_idx
+                req.generated.append(tok)
+                if len(req.generated) >= req.max_new:
+                    req.t_finish = now
+                    req.finish_step = self.step_idx
+                    self.pool_alloc.free(s.pages, self._shard_of(i))
+                    self.tables[i] = TRASH_PAGE
+                    self.slots[i] = None
+                    self.finished.append(req)
+                    done.append(req)
+        self.step_idx += 1
+        return done
+
+    def _place(self, tokens, pos, n_active):
+        """Host arrays → device, sharded like the step's in_specs."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_p2p.models.flagship import _axis
+
+        dp = _axis(self.mesh, "dp")
+        epx = _axis(self.mesh, "ep")
+        rows = tuple(a for a in (dp, epx) if a is not None) or None
+        mat = NamedSharding(self.mesh, P(rows, None))
+        vec = NamedSharding(self.mesh, P(rows))
+        return (jax.device_put(jnp.asarray(tokens), mat),
+                jax.device_put(jnp.asarray(pos), vec),
+                jax.device_put(jnp.asarray(n_active), vec),
+                jax.device_put(jnp.asarray(self.tables), mat))
+
+    def run(self, trace: List[Request]) -> List[Request]:
+        """Drive a whole step-indexed trace to completion; → finished
+        requests in finish order."""
+        pending = deque(sorted(trace, key=lambda r: (r.arrival_step,
+                                                     r.rid)))
+        while pending or not self.idle():
+            while pending and pending[0].arrival_step <= self.step_idx:
+                self.submit(pending.popleft())
+            self.step()
+        return self.finished
+
+
+def simulate_schedule(trace: List[Request], *, slots: int,
+                      page_len: int, num_pages: int, max_blocks: int,
+                      chunk: int, mode: str = "continuous",
+                      n_shards: int = 1) -> Dict:
+    """Run the scheduler WITHOUT a device: → the exact per-step input
+    sequence the mixed step would see, stacked for replay.
+
+    Returns ``{"steps", "idle_steps", "tokens": total processed
+    (prompt + generated), "stacked": {tokens/pos/n_active/table:
+    np [N, ...]}, "requests"}``. Valid because scheduling is
+    length-driven (module docstring): the 0-valued placeholder tokens
+    change no slot transition and no page movement.
+    """
+    trace = [dataclasses.replace(r, generated=[]) for r in trace]
+    b = Batcher(None, None, None,
+                slots=slots, page_len=page_len, num_pages=num_pages,
+                max_blocks=max_blocks, chunk=chunk, mode=mode,
+                dry=True, n_shards=n_shards)
+    finished = b.run(trace)
+    sched = b.schedule
+    stacked = {
+        k: np.stack([st[k] for st in sched])
+        for k in ("tokens", "pos", "n_active", "table")
+    } if sched else {}
+    tokens = sum(r.n_prompt + r.max_new for r in finished)
+    return {
+        "steps": len(sched),
+        "idle_steps": b.idle_steps,
+        "tokens": tokens,
+        "stacked": stacked,
+        "requests": finished,
+    }
+
+
+def percentile(values, q: float) -> Optional[float]:
+    """Nearest-rank percentile (the timeline's p99 convention — the
+    worst observed sample for small n, exactly what a tail metric
+    should pin on short runs). ``q`` in [0, 1]."""
+    vals = sorted(v for v in values if v is not None)
+    if not vals:
+        return None
+    idx = max(0, math.ceil(q * len(vals)) - 1)
+    return float(vals[idx])

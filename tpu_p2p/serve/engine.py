@@ -1,0 +1,337 @@
+"""Request scheduler + serving engine — ``python -m tpu_p2p serve``.
+
+Admits a synthetic many-request trace (seeded Poisson arrivals, mixed
+prompt/output lengths), drives the continuous batcher's mixed step in
+a host loop, and reports the serving headline: aggregate tokens/s
+(prompt + generated — every token the fleet processed), time-to-first-
+token p50/p99, and per-generated-token latency p50/p99. With
+``--obs-jsonl`` every request emits one span record into the same
+timeline stream the trainer writes (MegaScale-style per-request
+telemetry, docs/serving.md):
+
+    {"obs": "request", "id": 3, "prompt_tokens": 12,
+     "output_tokens": 8, "enqueue_step": 0, "prefill_start_step": 1,
+     "first_token_step": 4, "finish_step": 11, "queue_ms": 0.2,
+     "prefill_ms": 3.1, "ttft_ms": 3.3, "decode_ms": 9.8,
+     "total_ms": 13.1}
+
+plus one ``{"obs": "serve_summary"}`` record and — when the run
+captured a collective ledger — one ``{"obs": "serve_ledger"}`` totals
+record (:func:`tpu_p2p.obs.ledger.totals_record`), so the serve
+transport (the tp psum joins, the ep all_to_alls) is priced by the
+same machinery as training.
+
+``--batching both`` runs the continuous engine AND the static
+run-to-completion baseline on the same trace — the A/B bench grades
+(continuous must win on any trace with staggered lengths; when static
+wins instead, see docs/serving.md "when static batching wins").
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from tpu_p2p.config import ServeConfig, parse_range
+from tpu_p2p.serve.batcher import Batcher, Request, percentile
+
+__all__ = ["run_engine", "serve_mesh", "synthetic_trace", "main"]
+
+
+def serve_mesh(n_devices: int, devices=None):
+    """All devices on the dp axis — decode is token-recurrent, so the
+    serving mesh uses the batch axes (dp; ep via an explicit mesh for
+    MoE configs) and tp inside a slot; pp/sp stay 1 like
+    :func:`~tpu_p2p.models.decode.make_flagship_decode_step`
+    requires."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.array(devices[:n_devices]).reshape(n_devices),
+                ("dp",))
+
+
+def synthetic_trace(sc: ServeConfig) -> List[Request]:
+    """Seeded many-request trace: exponential inter-arrival gaps (a
+    Poisson process) measured in SCHEDULER STEPS — deterministic for a
+    seed, so step counts and the A/B comparison cannot drift with host
+    speed — prompt/output lengths uniform over the configured ranges,
+    prompt ids uniform over the vocab."""
+    rng = np.random.default_rng(sc.seed)
+    t = 0.0
+    reqs = []
+    for i in range(sc.requests):
+        t += rng.exponential(1.0 / sc.rate)
+        p = int(rng.integers(sc.prompt_len[0], sc.prompt_len[1] + 1))
+        g = int(rng.integers(sc.gen_len[0], sc.gen_len[1] + 1))
+        prompt = rng.integers(0, sc.vocab, p).astype(np.int32)
+        reqs.append(Request(rid=i, prompt=prompt, max_new=g,
+                            arrival_step=int(t)))
+    return reqs
+
+
+def _request_record(r: Request) -> dict:
+    def ms(a, b):
+        return (round((b - a) * 1e3, 3)
+                if a is not None and b is not None else None)
+
+    return {
+        "obs": "request",
+        "id": r.rid,
+        "prompt_tokens": r.n_prompt,
+        "output_tokens": len(r.generated),
+        "enqueue_step": r.enqueue_step,
+        "prefill_start_step": r.prefill_start_step,
+        "first_token_step": r.first_token_step,
+        "finish_step": r.finish_step,
+        "queue_ms": ms(r.t_enqueue, r.t_prefill_start),
+        "prefill_ms": ms(r.t_prefill_start, r.t_first_token),
+        "ttft_ms": ms(r.t_enqueue, r.t_first_token),
+        "decode_ms": ms(r.t_first_token, r.t_finish),
+        "total_ms": ms(r.t_enqueue, r.t_finish),
+    }
+
+
+def run_engine(mesh, cfg, params, trace: List[Request], *,
+               sc: ServeConfig, mode: str = "continuous",
+               emit=None, ledger=None,
+               clock=time.monotonic) -> dict:
+    """Serve ``trace`` to completion in one batching mode; → summary.
+
+    ``emit``: optional callable receiving JSON-ready obs records (the
+    ``--obs-jsonl`` sink); ``ledger``: optional
+    :class:`~tpu_p2p.obs.ledger.CollectiveLedger` — the mixed step is
+    then TRACED under recording, so its collective issues (tp joins,
+    ep reshards) land in the ledger like a training step's.
+    """
+    import dataclasses as _dc
+
+    trace = [_dc.replace(r, generated=[]) for r in trace]
+    batcher = Batcher(
+        mesh, cfg, params, slots=sc.slots, page_len=sc.page_len,
+        num_pages=sc.num_pages, max_blocks=sc.max_blocks,
+        chunk=sc.chunk, mode=mode, clock=clock)
+    t0 = clock()
+    if ledger is not None:
+        from tpu_p2p.obs.ledger import recording
+
+        with recording(ledger):
+            finished = batcher.run(trace)
+    else:
+        finished = batcher.run(trace)
+    wall = max(clock() - t0, 1e-9)
+    prompt_toks = sum(r.n_prompt for r in finished)
+    gen_toks = sum(len(r.generated) for r in finished)
+    ttft = [(r.t_first_token - r.t_enqueue) * 1e3 for r in finished
+            if r.t_first_token is not None]
+    # Per-generated-token decode latency: the steady-state token
+    # cadence after the first token (requests generating just one
+    # token have no decode interval to sample).
+    tok_ms = [(r.t_finish - r.t_first_token) * 1e3
+              / (len(r.generated) - 1)
+              for r in finished
+              if len(r.generated) > 1 and r.t_finish is not None]
+    summary = {
+        "mode": mode,
+        "requests": len(finished),
+        "steps": batcher.step_idx,
+        "idle_steps": batcher.idle_steps,
+        "prompt_tokens": prompt_toks,
+        "gen_tokens": gen_toks,
+        "wall_s": round(wall, 6),
+        "serve_tokens_per_s": round((prompt_toks + gen_toks) / wall, 3),
+        "gen_tokens_per_s": round(gen_toks / wall, 3),
+        "serve_ttft_ms_p50": _r3(percentile(ttft, 0.50)),
+        "serve_ttft_ms_p99": _r3(percentile(ttft, 0.99)),
+        "serve_tok_ms_p50": _r3(percentile(tok_ms, 0.50)),
+        "serve_tok_ms_p99": _r3(percentile(tok_ms, 0.99)),
+    }
+    if emit is not None:
+        for r in finished:
+            emit(_request_record(r))
+        emit({"obs": "serve_summary", **summary})
+        if ledger is not None:
+            # Zero issues is itself the receipt on a collective-free
+            # mesh (dp-only, tp/ep size 1 — no join crosses a link).
+            from tpu_p2p.obs.ledger import totals_record
+
+            emit(totals_record(ledger))
+    return summary
+
+
+def _r3(v):
+    return round(v, 3) if v is not None else None
+
+
+def _engine_model(sc: ServeConfig):
+    """The CLI's serving model: a small dense-FFN LM (RoPE + RMSNorm,
+    GQA 2:1) — big enough that the mixed step exercises every layer,
+    small enough that the 8-device CPU golden run stays fast. MoE
+    serving is covered by the parity tests (no-drop capacity); the
+    CLI keeps the FFN dense so slot-masked garbage tokens cannot
+    perturb routing capacity (docs/serving.md)."""
+    from tpu_p2p.models import flagship as F
+
+    return F.FlagshipConfig(
+        batch=sc.slots, seq=16, heads=4, kv_heads=2, head_dim=16,
+        stages=2, microbatches=1, dense_ffn=True, moe_mult=2,
+        vocab=sc.vocab, norm=True, rope=True, dtype=sc.dtype,
+    )
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_p2p serve",
+        description="Serving engine smoke: paged KV cache + continuous "
+                    "batching over a synthetic Poisson request trace.",
+    )
+    p.add_argument("--requests", type=int, default=8,
+                   help="trace length (synthetic requests)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="trace seed (arrivals, lengths, prompt ids)")
+    p.add_argument("--rate", type=float, default=1.0,
+                   help="mean arrivals per scheduler step (Poisson)")
+    p.add_argument("--prompt-len", default="4:12", metavar="LO:HI",
+                   help="prompt length range, inclusive")
+    p.add_argument("--gen-len", default="4:8", metavar="LO:HI",
+                   help="generated length range, inclusive")
+    p.add_argument("--slots", type=int, default=8,
+                   help="fixed-width slot batch (must divide by dp×ep)")
+    p.add_argument("--page-len", type=int, default=8,
+                   help="tokens per KV page (multiple of 8)")
+    p.add_argument("--pages", type=int, default=None,
+                   help="global page-pool size (default: sized to the "
+                        "trace's worst request on every slot)")
+    p.add_argument("--chunk", type=int, default=4,
+                   help="prefill chunk width (1/2/4/8 tokens per step)")
+    p.add_argument("--vocab", type=int, default=128,
+                   help="synthetic vocabulary size")
+    p.add_argument("--dtype", default="float32",
+                   help="model/cache dtype")
+    from tpu_p2p.config import BATCHING
+
+    p.add_argument("--batching", default="both", choices=BATCHING,
+                   help="batching mode(s) to run — 'both' prints the "
+                        "A/B on the same trace")
+    p.add_argument("--obs-jsonl", default=None, metavar="PATH",
+                   help="append per-request span records + the serve "
+                        "summary to this JSONL timeline")
+    p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
+                   help="testing: force CPU platform with N simulated "
+                        "devices")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _build_parser().parse_args(
+        list(sys.argv[1:] if argv is None else argv))
+    from tpu_p2p.utils.errors import fail_fast
+
+    try:
+        if args.cpu_mesh:
+            from tpu_p2p.cli import _force_cpu_mesh
+
+            _force_cpu_mesh(args.cpu_mesh)
+        import jax
+
+        from tpu_p2p.models import flagship as F
+
+        n = len(jax.devices())
+        mesh = serve_mesh(n)
+        prompt_rng = parse_range(args.prompt_len)
+        gen_rng = parse_range(args.gen_len)
+        max_len = prompt_rng[1] + gen_rng[1]
+        max_blocks = -(-max_len // args.page_len)
+        pages = args.pages
+        if pages is None:
+            # Worst case every slot serves a max-length request, plus
+            # each shard's trash page.
+            pages = (args.slots * max_blocks + n)
+            pages += (-pages) % n
+        sc = ServeConfig(
+            slots=args.slots, page_len=args.page_len, num_pages=pages,
+            max_blocks=max_blocks, chunk=args.chunk,
+            batching=args.batching, requests=args.requests,
+            seed=args.seed, rate=args.rate, prompt_len=prompt_rng,
+            gen_len=gen_rng, vocab=args.vocab, dtype=args.dtype,
+        )
+        cfg = _engine_model(sc)
+        params = F.place_flagship_params(F.init_flagship_params(cfg),
+                                         mesh)
+        trace = synthetic_trace(sc)
+        axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        print(f"serve mesh {axes}: slots={sc.slots} "
+              f"page_len={sc.page_len} pages={sc.num_pages} "
+              f"window={sc.max_blocks * sc.page_len} chunk={sc.chunk} "
+              f"vocab={sc.vocab} {sc.dtype}")
+        print(f"trace: {sc.requests} requests seed={sc.seed} "
+              f"rate={sc.rate}/step prompt {prompt_rng[0]}-"
+              f"{prompt_rng[1]} gen {gen_rng[0]}-{gen_rng[1]}")
+        emit = None
+        fh = None
+        if args.obs_jsonl:
+            import json as _json
+
+            fh = open(args.obs_jsonl, "a")
+
+            def emit(rec, fh=fh):
+                fh.write(_json.dumps(rec) + "\n")
+                fh.flush()
+        modes = (("continuous", "static") if args.batching == "both"
+                 else (args.batching,))
+        ledger = None
+        if emit is not None:
+            # The serve transport receipt rides the obs stream
+            # (docs/serving.md trace schema) — priced by the same
+            # instrumented wrappers as a training step's collectives.
+            from tpu_p2p.obs.ledger import CollectiveLedger
+
+            ledger = CollectiveLedger()
+        try:
+            summaries = {}
+            for mode in modes:
+                if ledger is not None:
+                    ledger.clear()
+                s = run_engine(mesh, cfg, params, trace, sc=sc,
+                               mode=mode, emit=emit, ledger=ledger)
+                summaries[mode] = s
+                print(f"{mode}: {s['requests']} requests, "
+                      f"{s['prompt_tokens']} prompt + "
+                      f"{s['gen_tokens']} generated tokens in "
+                      f"{s['steps']} steps ({s['idle_steps']} idle)")
+                print(f"  {s['serve_tokens_per_s']:,.0f} tokens/s  "
+                      f"ttft p50 {_f(s['serve_ttft_ms_p50'])}ms "
+                      f"p99 {_f(s['serve_ttft_ms_p99'])}ms  "
+                      f"tok p50 {_f(s['serve_tok_ms_p50'])}ms "
+                      f"p99 {_f(s['serve_tok_ms_p99'])}ms")
+            if len(modes) == 2:
+                # The deterministic A/B: non-idle scheduler step
+                # counts on the same trace (host-speed-independent,
+                # unlike wall tokens/s on a loaded CI box).
+                busy = {m: s["steps"] - s["idle_steps"]
+                        for m, s in summaries.items()}
+                print(f"A/B schedule: continuous "
+                      f"{busy['continuous']} steps vs static "
+                      f"{busy['static']} steps "
+                      f"({busy['static'] / max(busy['continuous'], 1):.2f}x)")
+        finally:
+            if fh is not None:
+                fh.close()
+        return 0
+    except KeyboardInterrupt:
+        print("interrupted", file=sys.stderr)
+        return 130
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 — single fail-fast (L8)
+        return fail_fast(e)
+
+
+def _f(v):
+    return f"{v:.1f}" if v is not None else "-"

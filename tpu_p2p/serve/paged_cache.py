@@ -1,0 +1,354 @@
+"""Paged KV cache — pages, tables, free-list, and the mixed step.
+
+The dense decode cache (:mod:`tpu_p2p.models.decode`) allocates
+``[B, max_len]`` KV rows per sequence up front; a serving fleet cannot
+— requests arrive with wildly different prompt/output lengths, and a
+dense ``max_len`` per slot strands most of its HBM. The paged layout
+(Pope et al. 2022's batched-inference regime; the vLLM-style block
+table) replaces it:
+
+- **The pool**: per projection, ``[stages, num_pages, H_kv, page_len,
+  Dh]`` — a flat pool of fixed-size pages, sharded exactly like the
+  dense cache (pages over the dp/ep batch axes where the dense cache
+  sharded its batch, KV heads over tp — :func:`paged_pool_spec` IS
+  ``decode.cache_spec``). Logical position ``p`` of a request lives in
+  its ``p // page_len``-th page at row ``p % page_len``.
+- **Page tables**: per slot, ``[max_blocks]`` int32 of shard-local
+  page indices (block order = logical order). Unallocated blocks point
+  at the reserved **trash page 0** — reads from them are always masked
+  (their positions exceed the sequence length), and idle slots' no-op
+  writes land there.
+- **The free-list** (:class:`PagePool`): host-side, per shard —
+  allocation and free are O(pages touched), and a finished request's
+  pages return to the pool immediately (the paged win: pages, not
+  ``max_len`` slots, are the unit of occupancy).
+- **The mixed step** (:func:`make_paged_lm_step`): ONE compiled
+  program serving every slot state — each slot independently processes
+  ``n_active`` ∈ ``[0, chunk]`` tokens (a prefill chunk, a single
+  decode token, or nothing), writes them into its pages through the
+  aliased-Pallas band kernel (:func:`tpu_p2p.ops.kvcache.
+  paged_rows_write`), and attends over its page-gathered KV with a
+  per-slot causal mask. The attention/FFN math is
+  :func:`tpu_p2p.models.decode._attend_ffn` — the SAME body the dense
+  decode step compiles, which is what makes paged-vs-dense parity
+  bitwise (tests/test_serve.py).
+
+Masking makes page garbage unreachable: dead keys score ``NEG_INF``,
+whose softmax weight underflows to an exact 0, so stale rows in
+recycled pages (and anything on the trash page) contribute an exact
+``0.0`` to the output — the same argument the dense cache's
+beyond-``pos`` mask rests on.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_p2p.models.decode import (
+    _attend_ffn,
+    _check_decode_mesh,
+    _decode_param_specs,
+    cache_spec,
+)
+from tpu_p2p.models.flagship import FlagshipConfig, _axis, _fsdp_plan, _mesh_axes
+from tpu_p2p.ops.kvcache import paged_rows_write
+
+Pool = Dict[str, jax.Array]
+
+# Local page 0 of every shard is reserved: idle/inactive writes are
+# routed there and tables point unallocated blocks at it, so a no-op
+# write can never touch a live page. The free-list never hands it out.
+TRASH_PAGE = 0
+
+
+class OutOfPages(RuntimeError):
+    """Free-list exhausted — the scheduler's admission signal."""
+
+
+class PagePool:
+    """Host-side page free-list, one list per (dp × ep) shard.
+
+    Page indices are SHARD-LOCAL (they index the shard's slice of the
+    pool, which is what the shard_map body sees), so a request's pages
+    must come from the shard that owns its slot rows — the batcher
+    pins slots to shards accordingly. Invariants (pinned in
+    tests/test_serve.py): a page is never handed out twice, the trash
+    page is never handed out, freeing a page not currently allocated
+    (or double-freeing) raises, and after every request of a trace
+    finishes the pool is exactly full again (no leak).
+    """
+
+    def __init__(self, num_pages: int, page_len: int,
+                 n_shards: int = 1) -> None:
+        if page_len <= 0 or page_len % 8:
+            raise ValueError(
+                f"page_len must be a positive multiple of 8 (the band "
+                f"write granularity), got {page_len}"
+            )
+        if n_shards <= 0 or num_pages % n_shards:
+            raise ValueError(
+                f"num_pages ({num_pages}) must divide by the shard "
+                f"count ({n_shards})"
+            )
+        per_shard = num_pages // n_shards
+        if per_shard < 2:
+            raise ValueError(
+                f"need >= 2 pages per shard (trash + 1 usable), got "
+                f"{per_shard}"
+            )
+        self.page_len = page_len
+        self.n_shards = n_shards
+        self.pages_per_shard = per_shard
+        self._free: List[List[int]] = [
+            list(range(per_shard - 1, TRASH_PAGE, -1))
+            for _ in range(n_shards)
+        ]
+        self._allocated = [set() for _ in range(n_shards)]
+
+    @property
+    def capacity(self) -> int:
+        """Usable pages per shard (the trash page is not usable)."""
+        return self.pages_per_shard - 1
+
+    def available(self, shard: int = 0) -> int:
+        return len(self._free[shard])
+
+    def alloc(self, shard: int = 0) -> int:
+        """→ one shard-local page index; raises :class:`OutOfPages`."""
+        if not self._free[shard]:
+            raise OutOfPages(
+                f"shard {shard}: all {self.capacity} pages in use"
+            )
+        pid = self._free[shard].pop()
+        self._allocated[shard].add(pid)
+        return pid
+
+    def alloc_n(self, n: int, shard: int = 0) -> List[int]:
+        """Allocate ``n`` pages atomically (all or nothing)."""
+        if self.available(shard) < n:
+            raise OutOfPages(
+                f"shard {shard}: need {n} pages, "
+                f"{self.available(shard)} free"
+            )
+        return [self.alloc(shard) for _ in range(n)]
+
+    def free(self, pages: Sequence[int], shard: int = 0) -> None:
+        for pid in pages:
+            if pid not in self._allocated[shard]:
+                raise ValueError(
+                    f"shard {shard}: page {pid} is not allocated "
+                    "(double free, trash page, or out of range)"
+                )
+            self._allocated[shard].remove(pid)
+            self._free[shard].append(pid)
+
+
+def paged_pool_spec(mesh: Mesh) -> P:
+    """``[stages, num_pages, H_kv, page_len, Dh]``: pages over dp/ep
+    (where the dense cache shards its batch), KV heads over tp — the
+    literal :func:`tpu_p2p.models.decode.cache_spec`."""
+    return cache_spec(mesh)
+
+
+def pool_shards(mesh: Mesh) -> int:
+    """How many ways the page axis splits (dp × ep sizes)."""
+    n = 1
+    for ax in ("dp", "ep"):
+        if ax in mesh.axis_names:
+            n *= mesh.shape[ax]
+    return n
+
+
+def init_paged_pool(cfg: FlagshipConfig, num_pages: int, page_len: int,
+                    mesh: Mesh) -> Pool:
+    """Zeroed page pool for ``num_pages`` GLOBAL pages (must divide by
+    the dp×ep shard count; each shard owns a contiguous slice its
+    local tables index)."""
+    _check_decode_mesh(mesh, cfg)
+    if page_len <= 0 or page_len % 8:
+        raise ValueError(
+            f"page_len must be a positive multiple of 8, got {page_len}"
+        )
+    n_shards = pool_shards(mesh)
+    if num_pages % n_shards:
+        raise ValueError(
+            f"num_pages ({num_pages}) must divide by the dp×ep shard "
+            f"count ({n_shards})"
+        )
+    shape = (cfg.stages, num_pages, cfg.num_kv_heads, page_len,
+             cfg.head_dim)
+    sharding = NamedSharding(mesh, paged_pool_spec(mesh))
+
+    def zeros():
+        # Fresh buffer per tensor (donation aliasing — see
+        # decode.init_kv_cache).
+        return jax.device_put(jnp.zeros(shape, jnp.dtype(cfg.dtype)),
+                              sharding)
+
+    return {"k": zeros(), "v": zeros()}
+
+
+def _gather_pages(pool_s, table):
+    """``pool_s [P_loc, H, L, Dh]`` × ``table [B_loc, max_blocks]`` →
+    the per-slot logical KV view ``[B_loc, H, max_blocks·L, Dh]``
+    (block order = logical order, so index ``p`` of the view is
+    logical position ``p`` — garbage beyond the sequence masked by the
+    caller)."""
+    g = jnp.take(pool_s, table, axis=0)     # [B, mb, H, L, Dh]
+    b, mb, h, l, dh = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, h, mb * l, dh)
+
+
+def _rope_rows(x, positions):
+    """Per-slot RoPE: ``x [B, H, C, Dh]`` rotated by ``positions
+    [B, C]`` (each slot sits at its own offset — the vmapped twin of
+    the dense step's scalar-position rotation)."""
+    from tpu_p2p.ops.rope import apply_rope
+
+    return jax.vmap(lambda xb, pb: apply_rope(xb[None], pb)[0])(
+        x, positions)
+
+
+def _place_band_rows(t, r0):
+    """``t [B, H, C, Dh]`` (C ≤ 8 token rows) → the ``[B, H, 8, Dh]``
+    band image with row ``i`` placed at band row ``r0[b] + i`` — the
+    slab :func:`tpu_p2p.ops.kvcache.paged_rows_write` consumes. Rows
+    outside the placed range hold clipped copies the write select
+    ignores."""
+    b, h, c, dh = t.shape
+    rows = jnp.arange(8, dtype=jnp.int32)
+    idx = jnp.clip(rows[None, :] - r0[:, None], 0, c - 1)  # [B, 8]
+    idx = jnp.broadcast_to(idx[:, None, :, None], (b, h, 8, dh))
+    return jnp.take_along_axis(t, idx, axis=2)
+
+
+def make_paged_lm_step(mesh: Mesh, cfg: FlagshipConfig, *,
+                       page_len: int, max_blocks: int, chunk: int):
+    """Jitted mixed prefill/decode step over a fixed-width slot batch:
+
+    ``(params, pool, tokens [B, C], pos [B], n_active [B],
+    table [B, max_blocks]) → (pool, logits [B, C, vocab])``
+
+    Per slot ``b``: tokens ``tokens[b, :n_active[b]]`` occupy logical
+    positions ``pos[b] .. pos[b] + n_active[b] - 1`` — a prefill chunk
+    (``n_active`` up to ``chunk``), a single decode token
+    (``n_active = 1``), or an idle slot (``n_active = 0``, writes
+    routed to the trash page, every key masked). Each slot's K/V rows
+    are written into ITS pages first, then attention runs over the
+    page-gathered view with the per-slot causal mask ``key_pos ≤
+    query_pos`` — which covers intra-chunk causality for free, since
+    the chunk's own rows are already resident. Rows ``c ≥ n_active[b]``
+    produce garbage logits the caller must ignore (they write nothing
+    and no live query attends to them).
+
+    Chunk constraint: ``chunk ∈ {1, 2, 4, 8}`` and multi-token chunks
+    must start at ``pos ≡ 0 (mod chunk)`` — then a chunk never crosses
+    the 8-row band (nor the page) the band-write kernel touches. The
+    batcher's prefill stepping guarantees it; single-token writes are
+    unconstrained.
+
+    Same shardings as :func:`~tpu_p2p.models.decode.
+    make_flagship_decode_step`: slots (and tables) over dp/ep, KV
+    heads over tp (psum join via the instrumented wrapper), pages over
+    dp/ep with shard-LOCAL table indices. The pool argument is
+    donated.
+    """
+    from tpu_p2p.models.flagship import _rms_norm
+    from tpu_p2p.parallel import fsdp
+
+    if not cfg.vocab:
+        raise ValueError("cfg.vocab must be > 0 for the serving step")
+    if chunk not in (1, 2, 4, 8):
+        raise ValueError(
+            f"chunk must be one of 1/2/4/8 (band-aligned prefill), "
+            f"got {chunk}"
+        )
+    if page_len % 8:
+        raise ValueError(
+            f"page_len must be a multiple of 8, got {page_len}"
+        )
+    if cfg.attn_window:
+        raise ValueError(
+            "the paged step masks by position; attn_window is not "
+            "supported (size the page window instead)"
+        )
+    _check_decode_mesh(mesh, cfg)
+    axes = _mesh_axes(mesh)
+    tp, ep = axes.get("tp"), axes.get("ep")
+    plan = _fsdp_plan(mesh, cfg)
+
+    dp_ax, ep_ax = _axis(mesh, "dp"), _axis(mesh, "ep")
+    batch_axes = tuple(a for a in (dp_ax, ep_ax) if a is not None)
+    row_spec = batch_axes if batch_axes else None
+    c_spec = paged_pool_spec(mesh)
+    compute = jnp.dtype(cfg.dtype)
+    t_win = max_blocks * page_len
+
+    def step(params, pool, tokens, pos, n_active, table):
+        if plan:
+            params = fsdp.all_gather_params(params, "dp", plan)
+        x = jnp.take(params["emb"], tokens, axis=0).astype(compute)
+        k_pool, v_pool = pool["k"], pool["v"]
+        b, c = tokens.shape
+        offs = jnp.arange(c, dtype=jnp.int32)
+        qpos = pos[:, None] + offs[None, :]             # [B, C]
+        # Write coordinates — one band per slot per step (see the
+        # chunk constraint above). Inactive slots park on the trash
+        # page with n = 0 (the kernel's no-op write).
+        blk = pos // page_len
+        page = jnp.where(
+            n_active > 0,
+            jnp.take_along_axis(table, blk[:, None], axis=1)[:, 0],
+            TRASH_PAGE,
+        ).astype(jnp.int32)
+        band = ((pos % page_len) // 8).astype(jnp.int32)
+        r0 = (pos % 8).astype(jnp.int32)
+        kp = jnp.arange(t_win, dtype=jnp.int32)
+        # Per-slot causal mask over the gathered window; query rows
+        # beyond n_active mask everything (their uniform-softmax
+        # output is discarded garbage by contract).
+        live = (kp[None, None, :] <= qpos[:, :, None]) \
+            & (offs[None, :] < n_active[:, None])[:, :, None]
+        live = live[:, None, None, :, :]                # [B,1,1,C,T]
+        for s in range(cfg.stages):
+            sub = {kk: (vv[s].astype(compute) if vv.dtype != compute
+                        else vv[s])
+                   for kk, vv in params.items()
+                   if kk not in ("emb", "lnf")}
+            h = _rms_norm(x, sub["ln1"]) if cfg.norm else x
+            k_t = jnp.einsum("btm,hmd->bhtd", h, sub["wk"])
+            v_t = jnp.einsum("btm,hmd->bhtd", h, sub["wv"])
+            if cfg.rope:
+                k_t = _rope_rows(k_t, qpos)
+            k_pool = paged_rows_write(
+                k_pool, _place_band_rows(k_t, r0), page, band, r0,
+                n_active, s)
+            v_pool = paged_rows_write(
+                v_pool, _place_band_rows(v_t, r0), page, band, r0,
+                n_active, s)
+            kb = _gather_pages(k_pool[s], table)
+            vb = _gather_pages(v_pool[s], table)
+            q = jnp.einsum("btm,hmd->bhtd", h, sub["wq"])
+            if cfg.rope:
+                q = _rope_rows(q, qpos)
+            x = _attend_ffn(sub, x, q, kb, vb, live, cfg, tp, ep)
+        if cfg.norm:
+            x = _rms_norm(x, params["lnf"])
+        logits = jnp.einsum("btm,vm->btv", x.astype(compute),
+                            params["emb"].astype(compute),
+                            preferred_element_type=jnp.float32)
+        return {"k": k_pool, "v": v_pool}, logits
+
+    specs = _decode_param_specs(mesh, cfg)
+    pool_specs = {"k": c_spec, "v": c_spec}
+    sm = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(specs, pool_specs, P(row_spec, None), P(row_spec),
+                  P(row_spec), P(row_spec, None)),
+        out_specs=(pool_specs, P(row_spec, None, None)),
+    )
+    return jax.jit(sm, donate_argnums=(1,))
