@@ -43,6 +43,98 @@ def pytest_configure(config):
     )
 
 
+# -------------------------------------------------- schedule parity
+# Shared pipeline-schedule parity harness (round 14 satellite): the
+# mesh builders, the tiny flagship config, and the two-config step
+# parity assert used to be duplicated across test_pp_overlap.py and
+# test_pipeline_1f1b.py (and would have been triplicated by the
+# schedule-IR equivalence suite). One definition here; test modules
+# `import conftest` (pytest puts tests/ on sys.path for rootdir
+# conftest resolution).
+
+
+def parity_mesh(names, shape):
+    """A named mesh over the first prod(shape) simulated devices."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    n = int(np.prod(shape))
+    return Mesh(np.array(jax.devices()[:n]).reshape(shape), names)
+
+
+def flagship_cfg(**kw):
+    """The tiny flagship config every pp parity suite runs."""
+    from tpu_p2p.models import flagship as F
+
+    base = dict(batch=8, seq=16, heads=4, head_dim=8, stages=2,
+                microbatches=2, num_experts=4, capacity_factor=8.0)
+    base.update(kw)
+    return F.FlagshipConfig(**base)
+
+
+def pipeline_setup(stages=4, m=4, b=8, t=8, d=16, f=32, seed=0):
+    """A tiny residual-MLP pipeline problem: (cfg, params, x, target)
+    — the shared fixture of the 1F1B and schedule-IR suites."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_p2p.models import pipeline as PL
+
+    cfg = PL.PipelineConfig(d_model=d, d_ff=f, stages=stages,
+                            microbatches=m)
+    params = PL.init_pipeline_params(cfg, seed=seed)
+    rng = np.random.default_rng(seed + 1)
+    x = jnp.asarray(rng.standard_normal((b, t, d)), dtype=jnp.float32)
+    target = jnp.asarray(rng.standard_normal((b, t, d)),
+                         dtype=jnp.float32)
+    return cfg, params, x, target
+
+
+def assert_flagship_step_parity(mesh, cfg_n, cfg_v, lm=False,
+                                one_f1b=False, exact=True):
+    """One SGD step under two flagship configs: loss and every updated
+    param agree — bitwise when ``exact`` (schedules that touch no
+    arithmetic: the pp wave, the zb dB/dW split), allclose otherwise
+    (compositions whose ADDED schedule carries its own fusion-level
+    tolerance). ``one_f1b`` runs the manual (interleaved-machinery)
+    executor instead of the GPipe autodiff step; ``lm`` the
+    cross-entropy token step."""
+    import numpy as np
+
+    from tpu_p2p.models import flagship as F
+
+    params = F.init_flagship_params(cfg_n)
+    if one_f1b:
+        x, t = F.flagship_example_batch(cfg_n, mesh)
+        p_n = F.place_flagship_params_pipelined(params, mesh, cfg_n)
+        p_v = F.place_flagship_params_pipelined(params, mesh, cfg_v)
+        mk = F.make_flagship_train_step_1f1b
+    else:
+        if lm:
+            x, t = F.flagship_token_batch(cfg_n, mesh)
+            mk = F.make_flagship_lm_train_step
+        else:
+            x, t = F.flagship_example_batch(cfg_n, mesh)
+            mk = F.make_flagship_train_step
+        p_n = F.place_flagship_params(params, mesh, cfg_n)
+        p_v = F.place_flagship_params(params, mesh, cfg_v)
+    new_n, l_n = mk(mesh, cfg_n, lr=1e-2)(p_n, x, t)
+    new_v, l_v = mk(mesh, cfg_v, lr=1e-2)(p_v, x, t)
+    if exact:
+        assert float(l_v) == float(l_n)
+        for k in params:
+            np.testing.assert_array_equal(
+                np.asarray(new_v[k]), np.asarray(new_n[k]), err_msg=k)
+        return
+    np.testing.assert_allclose(float(l_v), float(l_n), rtol=1e-6)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(new_v[k]), np.asarray(new_n[k]),
+            atol=1e-5, rtol=1e-5, err_msg=k,
+        )
+
+
 @pytest.fixture(scope="session")
 def rt():
     """A validated 8-device runtime on the simulated CPU mesh."""

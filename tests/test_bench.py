@@ -304,6 +304,13 @@ def test_main_multichip_branch_schema(capsys, monkeypatch, tmp_path):
         bench, "_pp_overlap_metrics",
         lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
     )
+    # The schedule-IR metric compiles two manual-executor flagship
+    # chains — real coverage lives in test_pp_sched_metrics_cpu_mesh;
+    # here exercise the failure wiring (nulls + the reason key).
+    monkeypatch.setattr(
+        bench, "_pp_sched_metrics",
+        lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
+    )
     # The health smoke runs two full instrumented train loops —
     # real coverage lives in tests/test_obs_health.py; here exercise
     # the failure wiring (explicit nulls, schema intact).
@@ -327,6 +334,9 @@ def test_main_multichip_branch_schema(capsys, monkeypatch, tmp_path):
     assert r["detail"]["tp_step_ms_overlap_ring"] is None
     assert r["detail"]["pp_overlap_frac"] is None
     assert r["detail"]["pp_step_ms_overlap_wave"] is None
+    assert r["detail"]["pp_bubble_frac_zb"] is None
+    assert r["detail"]["pp_step_ms_sched_zb"] is None
+    assert "RuntimeError" in r["detail"]["sched_error"]
     assert r["detail"]["ring_achieved_gbps"] is None
     assert r["detail"]["obs_step_ms_p50"] is None
     assert r["detail"]["health_detect_steps"] is None
@@ -397,6 +407,7 @@ def test_main_multichip_bad_env_falls_back(capsys, monkeypatch, tmp_path):
     monkeypatch.setattr(bench, "_tp_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_ep_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_pp_overlap_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_pp_sched_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_obs_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_health_metrics", lambda t: {})
     _, r = _run_main(capsys, monkeypatch, tmp_path)
@@ -422,6 +433,7 @@ def test_main_multichip_device_sourced_cells(capsys, monkeypatch,
     monkeypatch.setattr(bench, "_tp_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_ep_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_pp_overlap_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_pp_sched_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_obs_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_health_metrics", lambda t: {})
     _, r = _run_main(capsys, monkeypatch, tmp_path)
@@ -511,6 +523,13 @@ def test_main_single_chip_branch_schema(capsys, monkeypatch, tmp_path):
     )
     monkeypatch.setattr(
         bench, "_obs_metrics",
+        lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
+    )
+    # The schedule-IR metric compiles two manual-executor flagship
+    # chains (per-tick vjp — far heavier than the GPipe twins); real
+    # coverage lives in test_pp_sched_metrics_cpu_mesh.
+    monkeypatch.setattr(
+        bench, "_pp_sched_metrics",
         lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
     )
     monkeypatch.setattr(
@@ -655,6 +674,7 @@ def test_single_chip_headline_vs_baseline_uses_device_kind(capsys,
     monkeypatch.setattr(bench, "_decode_hbm_metrics", lambda t, p: {})
     monkeypatch.setattr(bench, "_fsdp_overlap_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_tp_overlap_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_pp_sched_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_obs_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_health_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_serve_metrics", lambda t: {})
@@ -825,6 +845,71 @@ def test_pp_overlap_metrics_cpu_mesh(monkeypatch):
     assert set(out) == set(bench.PP_NULL)
 
 
+def test_pp_sched_analytic_fracs_and_zb_claim():
+    # The analytic half of _pp_sched_metrics is device-free: the
+    # bubble fractions at the fixed canonical shape come straight off
+    # the compiled tick programs, and the tentpole's graded claim —
+    # zb strictly under 1f1b — holds by construction (the full
+    # schedule-property matrix is tests/test_schedule.py).
+    from tpu_p2p.models import schedule as SCH
+
+    f1 = SCH.bubble_fraction(SCH.compile_1f1b(
+        bench.SCHED_ANALYTIC_M, bench.SCHED_ANALYTIC_S))
+    fz = SCH.bubble_fraction(SCH.compile_zb(
+        bench.SCHED_ANALYTIC_M, bench.SCHED_ANALYTIC_S))
+    assert fz < f1
+
+
+def test_pp_sched_measured_failure_keeps_analytic_keys(monkeypatch):
+    # The two halves fail independently: the masked-SPMD executor
+    # makes zb lose the measured comparison on multi-device hosts
+    # (every rank executes every tick body — the _pp_sched_metrics
+    # docstring caveat), and that must null ONLY the step keys; the
+    # analytic bubble fractions are device-independent schedule
+    # properties and stay published with the reason alongside.
+    from tpu_p2p.utils import timing
+
+    monkeypatch.setattr(
+        bench, "_pp_sched_measured",
+        lambda t, mesh, n: (_ for _ in ()).throw(
+            RuntimeError("zb schedule lost on the measured step")),
+    )
+    out = bench._pp_sched_metrics(timing)
+    assert set(out) == set(bench.SCHED_NULL)
+    assert out["pp_bubble_frac_zb"] < out["pp_bubble_frac_1f1b"]
+    assert out["pp_step_ms_sched_1f1b"] is None
+    assert out["pp_step_ms_sched_zb"] is None
+    assert "zb schedule lost" in out["sched_error"]
+
+
+@pytest.mark.slow  # tier-1 budget (round 14): two full pp=8 MANUAL
+# flagship executor compiles (per-tick vjp); the zb path's tier-1
+# compile coverage rides tests/test_schedule.py::
+# test_flagship_zb_matches_1f1b_pp2 and the schema/null wiring is
+# pinned by SCHED_NULL's use in bench main().
+def test_pp_sched_metrics_cpu_mesh(monkeypatch):
+    # The schedule-IR twin of test_pp_overlap_metrics_cpu_mesh: both
+    # pp_schedule modes build + run a real pp=8 manual-executor step
+    # (the dB/dW split's compile coverage on the full visible mesh),
+    # the losses agree bitwise, the analytic fracs publish, and the
+    # measured pair comes back from the stubbed slope.
+    from tpu_p2p.utils import timing
+
+    monkeypatch.setattr(
+        bench, "_measure",
+        lambda t, mc, x, iters, repeats=3, runs=2:
+            _fake_headline(host=2e-3),
+    )
+    out = bench._pp_sched_metrics(timing)
+    assert out["sched_devices"] == 8
+    assert out["pp_bubble_frac_zb"] < out["pp_bubble_frac_1f1b"]
+    assert out["pp_step_ms_sched_1f1b"] == pytest.approx(2.0)
+    assert out["pp_step_ms_sched_zb"] == pytest.approx(2.0)
+    assert out["sched_source"] == "host_differential"
+    assert out["sched_error"] is None
+    assert set(out) == set(bench.SCHED_NULL)
+
+
 def test_compact_line_fits_with_every_headline_key_at_realistic_width():
     # Satellite contract (round 7): the ≤1 KiB budget must hold with
     # ALL headline keys present at realistic numeric widths — i.e. the
@@ -849,13 +934,21 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         "ep_step_ms_overlap_ring": 98.765,
         "pp_overlap_frac": 0.5432,
         "pp_step_ms_overlap_wave": 98.765,
+        # Round 14: the schedule-IR quartet joined the line;
+        # serve_tokens_per_s_static, flagship_step_ms,
+        # decode_ms_per_token, and obs_step_ms_p99 moved to
+        # BENCH_detail.json to make room (test_round14_budget_trade
+        # pins the move).
+        "pp_bubble_frac_1f1b": 0.4286,
+        "pp_bubble_frac_zb": 0.1905,
+        "pp_step_ms_sched_1f1b": 98.765,
+        "pp_step_ms_sched_zb": 98.765,
         "ring_achieved_gbps": 1234.56,
         "obs_step_ms_p50": 123.456,
-        # Round 12: the health trio joined the line; "devices" (the
+        # Round 12: the health pair joined the line; "devices" (the
         # byte-identical twin of the line's own top-level "n") and
         # "pairs_measured" (never gated, never drift-quoted) moved to
         # BENCH_detail.json to make room (the min/max_gbps precedent).
-        "obs_step_ms_p99": 234.567,
         "health_detect_steps": 2,
         "heal_resume_loss_delta": 0.019981,
         # Round 11: the dma-transport quartet joined the line; the
@@ -875,11 +968,8 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         # moved to BENCH_detail.json (test_round13_budget_trade pins
         # the move).
         "serve_tokens_per_s": 533333,
-        "serve_tokens_per_s_static": 412345,
         "serve_ttft_ms_p50": 1234.567,
         "serve_tok_ms_p99": 123.456,
-        "flagship_step_ms": 5.96,
-        "decode_ms_per_token": 0.123,
     }
     # Every headline key must have a realistic value in this test —
     # a key added to HEADLINE_KEYS without extending this table would
@@ -1054,10 +1144,42 @@ def test_round13_budget_trade():
         assert k not in TOLERANCES, k
     assert "latency_8b_oneop_p50_us" in bench.ONEOP_LATENCY_NULL
     assert "ag_achieved_gbps" in bench.OBS_NULL
-    for k in ("serve_tokens_per_s", "serve_tokens_per_s_static",
-              "serve_ttft_ms_p50", "serve_tok_ms_p99"):
+    # serve_tokens_per_s_static joined the line in round 13 and left
+    # it again in the round-14 trade (test_round14_budget_trade).
+    for k in ("serve_tokens_per_s", "serve_ttft_ms_p50",
+              "serve_tok_ms_p99"):
         assert k in bench.HEADLINE_KEYS, k
         assert k in bench.SERVE_NULL, k
+        assert k in TOLERANCES, k
+
+
+def test_round14_budget_trade():
+    # The round-14 budget trade, pinned like the round-11/13 ones:
+    # four keys left the compact line for the schedule-IR quartet but
+    # still measure into BENCH_detail.json (each stays in its metric's
+    # null schema). Their gate tolerances retired WITH them per the
+    # tolerance-⊆-headline rule: serve_tokens_per_s_static (the A/B
+    # baseline twin — continuous >= static is enforced inside
+    # _serve_metrics), flagship_step_ms (flagship_large_step_ms is the
+    # graded, drift-quoted flagship number), decode_ms_per_token (its
+    # serving-regime role passed to the serve keys, one round behind
+    # decode_hbm_ms_per_token), and obs_step_ms_p99 (the p50 twin
+    # stays as the cadence sentinel; serve_tok_ms_p99 still grades a
+    # host-loop p99).
+    from tpu_p2p.obs.regress import TOLERANCES
+
+    gone = ("serve_tokens_per_s_static", "flagship_step_ms",
+            "decode_ms_per_token", "obs_step_ms_p99")
+    for k in gone:
+        assert k not in bench.HEADLINE_KEYS, k
+        assert k not in TOLERANCES, k
+    assert "serve_tokens_per_s_static" in bench.SERVE_NULL
+    assert "obs_step_ms_p99" in bench.OBS_NULL
+    assert "decode_ms_per_token" in bench.DECODE_NULL
+    for k in ("pp_bubble_frac_1f1b", "pp_bubble_frac_zb",
+              "pp_step_ms_sched_1f1b", "pp_step_ms_sched_zb"):
+        assert k in bench.HEADLINE_KEYS, k
+        assert k in bench.SCHED_NULL, k
         assert k in TOLERANCES, k
 
 
@@ -1114,15 +1236,15 @@ def test_health_metrics_single_device_publishes_null_schema(monkeypatch):
 
 
 def test_health_keys_survive_compact_budget():
-    # Satellite contract (round 12): the health trio rides the ≤1 KiB
-    # compact line at realistic widths.
-    new = ("obs_step_ms_p99", "health_detect_steps",
-           "heal_resume_loss_delta")
+    # Satellite contract (round 12): the health pair rides the ≤1 KiB
+    # compact line at realistic widths. (obs_step_ms_p99 joined in
+    # round 12 and left the line in the round-14 budget trade —
+    # test_round14_budget_trade pins that move.)
+    new = ("health_detect_steps", "heal_resume_loss_delta")
     for k in new:
         assert k in bench.HEADLINE_KEYS, k
     detail = {
         "devices": 256,
-        "obs_step_ms_p99": 234.567,
         "health_detect_steps": 2,
         "heal_resume_loss_delta": 0.019981,
     }
@@ -1141,16 +1263,17 @@ def test_health_keys_survive_compact_budget():
 
 
 def test_serve_headline_keys_survive_compact_budget():
-    # Satellite contract (round 13): the serve quartet rides the
-    # ≤1 KiB compact line at realistic widths.
-    new = ("serve_tokens_per_s", "serve_tokens_per_s_static",
-           "serve_ttft_ms_p50", "serve_tok_ms_p99")
+    # Satellite contract (round 13): the serve keys ride the ≤1 KiB
+    # compact line at realistic widths. (serve_tokens_per_s_static
+    # left the line in the round-14 budget trade — the static baseline
+    # twin; test_round14_budget_trade pins that move.)
+    new = ("serve_tokens_per_s", "serve_ttft_ms_p50",
+           "serve_tok_ms_p99")
     for k in new:
         assert k in bench.HEADLINE_KEYS, k
     detail = {
         "devices": 256,
         "serve_tokens_per_s": 533333,
-        "serve_tokens_per_s_static": 412345,
         "serve_ttft_ms_p50": 1234.567,
         "serve_tok_ms_p99": 123.456,
     }

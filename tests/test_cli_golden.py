@@ -63,6 +63,16 @@ SUMMARY_PATTERNS = {
     "flagship_pp_wave": ["--cpu-mesh", "8", "--pattern",
                          "flagship_step", "--pp-overlap", "wave",
                          "--iters", "2"],
+    # The round-14 pp_schedule knob end to end: --pp-schedule zb
+    # routes flagship_step through the MANUAL executor running the
+    # zero-bubble tick program. Like the pp-wave pin, build_mesh lands
+    # pp=2 on 8 devices, so this runs a REAL dB/dW split (bwd_input
+    # ticks on the critical path, deferred bwd_weight ticks) end to
+    # end — plumbing, the pp_schedule=zb output contract, and the
+    # split executor under the full 5-axis mesh (the bitwise parity
+    # matrix itself lives in tests/test_schedule.py).
+    "flagship_zb": ["--cpu-mesh", "8", "--pattern", "flagship_step",
+                    "--pp-schedule", "zb", "--iters", "2"],
     # The round-11 pallas_dma transport end to end on the 8-device
     # mesh: the full uni-directional matrix over raw async-remote-copy
     # kernels (interpret mode on CPU), --check asserting every cell's

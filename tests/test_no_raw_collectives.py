@@ -86,6 +86,12 @@ def test_lint_scans_the_expected_trees():
     files = list(_py_files())
     names = {os.path.basename(p) for p in files}
     assert "moe.py" in names and "attention.py" in names, sorted(names)
+    # The round-14 tick-schedule IR executor ships every stage hop
+    # itself (schedule.py tick_grads_local / tick_forward_local) — a
+    # raw collective there would leak the WHOLE pipeline transport of
+    # any IR-compiled schedule past the ledger, so its lowering must
+    # stay inside the scanned tree.
+    assert "schedule.py" in names, sorted(names)
     # The round-13 serve tree is covered (paged_cache.py issues the
     # decode psum joins through the wrappers; a regression that drops
     # serve/ from SCANNED must fail here, not ship silently).

@@ -326,11 +326,15 @@ def test_live_capture_on_cpu_mesh_records_but_no_track(rt):
     n = rt.mesh.devices.size
     assert totals[("ppermute", "ep")]["issues"] == 2 * (n - 1)
     # pp stage hops: 1 scan-traced record (mode "none") + pp_chunks=2
-    # wave-chunk records (mode "wave"); one output-replicate psum per
-    # mode.
-    assert totals[("ppermute", "pp")]["issues"] == 3
+    # wave-chunk records (mode "wave") from the GPipe forwards, plus
+    # — round 14 — the tick-IR train steps under both pp_schedule
+    # programs (fused 1f1b and the zero-bubble split): each records
+    # one pp_fwd_ship + one pp_bwd_ship per scan trace (= 4 more).
+    # One output-replicate psum per GPipe mode + one loss-replicate
+    # psum per tick-IR program.
+    assert totals[("ppermute", "pp")]["issues"] == 7
     assert totals[("ppermute", "pp")]["wire_bytes"] > 0
-    assert totals[("all_reduce", "pp")]["issues"] == 2
+    assert totals[("all_reduce", "pp")]["issues"] == 4
     assert join.no_device_track  # CPU records host events only
     s = io.StringIO()
     L.print_report(led, join, n=8, stream=s)

@@ -1,27 +1,23 @@
 """1F1B pipeline schedule: static-table soundness + gradient parity
-with the GPipe step and the sequential single-device oracle."""
+with the GPipe step and the sequential single-device oracle.
+
+The mesh builder and the tiny pipeline problem live in
+tests/conftest.py (the round-14 shared schedule-parity harness —
+test_schedule.py runs the same fixtures against the compiled IR
+programs)."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh
 
+from conftest import parity_mesh, pipeline_setup as _setup
 from tpu_p2p.models import pipeline as PL
 from tpu_p2p.models import pipeline_1f1b as FB
 
 
 def _mesh(stages):
-    return Mesh(np.array(jax.devices()[:stages]), ("pp",))
-
-
-def _setup(stages=4, m=4, b=8, t=8, d=16, f=32, seed=0):
-    cfg = PL.PipelineConfig(d_model=d, d_ff=f, stages=stages, microbatches=m)
-    params = PL.init_pipeline_params(cfg, seed=seed)
-    rng = np.random.default_rng(seed + 1)
-    x = jnp.asarray(rng.standard_normal((b, t, d)), dtype=jnp.float32)
-    target = jnp.asarray(rng.standard_normal((b, t, d)), dtype=jnp.float32)
-    return cfg, params, x, target
+    return parity_mesh(("pp",), (stages,))
 
 
 # ---------------------------------------------------------------- schedule

@@ -8,72 +8,32 @@ FSDP prefetch and tp-ring schedules — mirroring tests/test_ep_overlap
 arithmetic (identity chunk compute, no sum crosses a chunk boundary),
 so parity is BITWISE everywhere, not just at the pp=1/pp_chunks=1
 degrade; the asserts are exact.
+
+The mesh builder, tiny config, and step-parity assert live in
+tests/conftest.py (the round-14 shared schedule-parity harness —
+test_pipeline_1f1b.py and test_schedule.py run the same helpers).
 """
 
-import jax
-import numpy as np
 import pytest
-from jax.sharding import Mesh
 
-from tpu_p2p.models import flagship as F
-
-
-def _mesh(names, shape):
-    n = int(np.prod(shape))
-    return Mesh(np.array(jax.devices()[:n]).reshape(shape), names)
-
-
-def _cfg(**kw):
-    base = dict(batch=8, seq=16, heads=4, head_dim=8, stages=2,
-                microbatches=2, num_experts=4, capacity_factor=8.0)
-    base.update(kw)
-    return F.FlagshipConfig(**base)
+from conftest import (
+    assert_flagship_step_parity,
+    flagship_cfg as _cfg,
+    parity_mesh as _mesh,
+)
 
 
 def _assert_step_parity(mesh, base_kw, variant_kw=None, lm=False,
                         one_f1b=False, pp_chunks=2, exact=True):
-    """One SGD step under pp_overlap='none' vs 'wave': loss and every
-    updated param agree bitwise. The wave ships the same bytes over
-    the same edges with identity chunk compute, so both schedules are
-    the same arithmetic in the same order. ``variant_kw`` adds extra
-    knobs to the wave side only (the compose cases: prefetch / tp
-    ring on top of the wave — ``exact=False`` there, because the
-    *added* schedule carries its own fusion-level tolerance, pinned in
-    its own suite); ``one_f1b`` runs the manual interleaved 1F1B
-    executor instead of the GPipe autodiff step.
-    """
+    """Wave-vs-none parity through the shared harness: ``variant_kw``
+    adds extra knobs to the wave side only (the compose cases —
+    ``exact=False`` there, because the *added* schedule carries its
+    own fusion-level tolerance, pinned in its own suite)."""
     cfg_n = _cfg(**base_kw)
     cfg_w = _cfg(**{**base_kw, "pp_overlap": "wave",
                     "pp_chunks": pp_chunks, **(variant_kw or {})})
-    params = F.init_flagship_params(cfg_n)
-    if one_f1b:
-        x, t = F.flagship_example_batch(cfg_n, mesh)
-        p_n = F.place_flagship_params_pipelined(params, mesh, cfg_n)
-        p_w = F.place_flagship_params_pipelined(params, mesh, cfg_w)
-        mk = F.make_flagship_train_step_1f1b
-    else:
-        if lm:
-            x, t = F.flagship_token_batch(cfg_n, mesh)
-            mk = F.make_flagship_lm_train_step
-        else:
-            x, t = F.flagship_example_batch(cfg_n, mesh)
-            mk = F.make_flagship_train_step
-        p_n = F.place_flagship_params(params, mesh, cfg_n)
-        p_w = F.place_flagship_params(params, mesh, cfg_w)
-    new_n, l_n = mk(mesh, cfg_n, lr=1e-2)(p_n, x, t)
-    new_w, l_w = mk(mesh, cfg_w, lr=1e-2)(p_w, x, t)
-    if exact:
-        assert float(l_w) == float(l_n)
-        for k in params:
-            np.testing.assert_array_equal(
-                np.asarray(new_w[k]), np.asarray(new_n[k]), err_msg=k)
-        return
-    np.testing.assert_allclose(float(l_w), float(l_n), rtol=1e-6)
-    for k in params:
-        np.testing.assert_allclose(
-            np.asarray(new_w[k]), np.asarray(new_n[k]),
-            atol=1e-5, rtol=1e-5, err_msg=k,
-        )
+    assert_flagship_step_parity(mesh, cfg_n, cfg_w, lm=lm,
+                                one_f1b=one_f1b, exact=exact)
 
 
 # ------------------------------------------------------------ parity
@@ -168,24 +128,13 @@ def test_tp_ring_and_pp_wave_compose():
     # tp_overlap="ring" (Megatron joins over tp) + pp_overlap="wave"
     # (stage hops over pp) on a tp x pp mesh: the block-internal ring
     # and the carry-wire wave both issue ppermutes, and the two
-    # schedules must compose against the double-"none" baseline. The
-    # tp ring reassociates its join sums, so THIS case is allclose,
-    # not bitwise — the wave side contributes no drift on top of the
-    # tp ring's own pinned tolerance (tests/test_tp_overlap.py).
-    mesh = _mesh(("tp", "pp"), (2, 2))
-    cfg_n = _cfg(tp_overlap="ring")
-    cfg_w = _cfg(tp_overlap="ring", pp_overlap="wave", pp_chunks=2)
-    params = F.init_flagship_params(cfg_n)
-    x, t = F.flagship_example_batch(cfg_n, mesh)
-    p_n = F.place_flagship_params(params, mesh, cfg_n)
-    p_w = F.place_flagship_params(params, mesh, cfg_w)
-    new_n, l_n = F.make_flagship_train_step(mesh, cfg_n, lr=1e-2)(p_n, x, t)
-    new_w, l_w = F.make_flagship_train_step(mesh, cfg_w, lr=1e-2)(p_w, x, t)
-    # Same tp-ring program either side of the wave: still bitwise.
-    assert float(l_w) == float(l_n)
-    for k in params:
-        np.testing.assert_array_equal(
-            np.asarray(new_w[k]), np.asarray(new_n[k]), err_msg=k)
+    # schedules must compose against the double-"none" baseline. Same
+    # tp-ring program either side of the wave: still bitwise.
+    assert_flagship_step_parity(
+        _mesh(("tp", "pp"), (2, 2)),
+        _cfg(tp_overlap="ring"),
+        _cfg(tp_overlap="ring", pp_overlap="wave", pp_chunks=2),
+    )
 
 
 # ---------------------------------------------------------- validation

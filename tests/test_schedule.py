@@ -1,0 +1,318 @@
+"""Unified tick-schedule IR (tpu_p2p/models/schedule.py): compiler
+soundness, analytic bubble accounting, ledger-convention pricing, and
+the tentpole equivalence contract — every legacy executor BITWISE
+equal to its compiled IR program, and the zero-bubble (ZB-H1-style)
+dB/dW split BITWISE equal to the fused 1F1B step it reschedules.
+
+Reuses the shared schedule-parity harness in tests/conftest.py
+(parity_mesh / pipeline_setup / flagship_cfg /
+assert_flagship_step_parity — the round-14 satellite that de-duplicated
+test_pipeline_1f1b.py's and test_pp_overlap.py's fixtures)."""
+
+import numpy as np
+import pytest
+
+from conftest import (
+    assert_flagship_step_parity,
+    flagship_cfg,
+    parity_mesh,
+    pipeline_setup,
+)
+from tpu_p2p.models import pipeline as PL
+from tpu_p2p.models import pipeline_1f1b as FB
+from tpu_p2p.models import pipeline_interleaved as IL
+from tpu_p2p.models import schedule as S
+
+
+# ---------------------------------------------------------- compilers
+
+
+@pytest.mark.parametrize("m,s", [(1, 1), (2, 2), (4, 4), (8, 4),
+                                 (4, 8), (3, 5), (4, 1), (1, 4)])
+def test_zb_program_complete_and_dependency_sound(m, s):
+    prog = S.compile_zb(m, s)
+    fwd = np.full((s, m), -1)
+    bi = np.full((s, m), -1)
+    w = np.full((s, m), -1)
+    for t, tick in enumerate(prog.ticks):
+        seen = set()
+        for op in tick.compute:
+            # One op per device per tick — the legacy builders' rule.
+            assert op.device not in seen, (t, op)
+            seen.add(op.device)
+            tbl = {"fwd": fwd, "bwd_input": bi, "bwd_weight": w,
+                   "bwd": bi}[op.kind]
+            assert tbl[op.device, op.microbatch] == -1
+            tbl[op.device, op.microbatch] = t
+    assert (fwd >= 0).all() and (bi >= 0).all(), "ops missing"
+    if s > 1:  # s == 1 degrades to the fused schedule (no W ticks)
+        assert (w >= 0).all(), "bwd_weight ops missing"
+    for st in range(s):
+        for mb in range(m):
+            if st > 0:  # activation needs a full tick on the wire
+                assert fwd[st, mb] > fwd[st - 1, mb]
+            if st < s - 1:  # gradient too
+                assert bi[st, mb] > bi[st + 1, mb]
+            assert bi[st, mb] > fwd[st, mb]
+            if s > 1:
+                # dW strictly after its dx tick (the stash re-read).
+                assert w[st, mb] > bi[st, mb]
+        if s > 1:
+            # The bitwise contract: per-stage dW accumulation stays in
+            # microbatch order, so the sum sequence matches the fused
+            # executor's.
+            assert list(np.argsort(w[st])) == list(range(m))
+
+
+def test_zb_degrades_to_fused_on_one_stage():
+    prog = S.compile_zb(4, 1)
+    assert prog.name == "zb"
+    assert not prog.has_split_backward
+    assert [  # the fused 1f1b ticks, renamed
+        (op.kind, op.microbatch)
+        for t in prog.ticks for op in t.compute
+    ] == [
+        (op.kind, op.microbatch)
+        for t in S.compile_1f1b(4, 1).ticks for op in t.compute
+    ]
+
+
+def test_compiled_legacy_programs_match_builder_tables():
+    # compile_interleaved emits the SAME tick tables the legacy
+    # executor runs (the greedy builder is shared), and the lowering
+    # reproduces the legacy slot coloring exactly.
+    m, n, v = 4, 2, 2
+    sched = IL.build_interleaved_schedule(m, n, v)
+    lowered = S.lower(S.compile_interleaved(m, n, v))
+    assert not lowered.split
+    assert lowered.act_slots == sched.act_slots
+    assert lowered.grad_slots == sched.grad_slots
+    for k in ("f_mb", "f_cidx", "f_slot", "b_mb", "b_cidx", "b_slot",
+              "recv_slot", "b_gslot", "grecv_slot"):
+        np.testing.assert_array_equal(lowered.tables[k],
+                                      getattr(sched, k), err_msg=k)
+
+
+def test_zb_stash_stays_schedule_bounded():
+    # The W-right-after-Bi policy keeps the activation stash
+    # 1F1B-shaped (O(S), not O(M)) — the memory property ZB-H1 is
+    # designed around.
+    for m, s in [(8, 4), (16, 4), (8, 8)]:
+        lowered = S.lower(S.compile_zb(m, s))
+        assert lowered.act_slots <= 2 * s + 2, (m, s,
+                                                lowered.act_slots)
+
+
+# ----------------------------------------------------------- analysis
+
+
+@pytest.mark.parametrize("m,s", [(2, 2), (4, 4), (8, 4), (4, 8),
+                                 (3, 5), (16, 4)])
+def test_zb_bubble_beats_1f1b_analytically(m, s):
+    # The tentpole's graded claim, at every shape with a real
+    # pipeline: the dB/dW split fills warmup/drain holes and halves
+    # the drain wave's per-stage latency.
+    assert (S.bubble_fraction(S.compile_zb(m, s))
+            < S.bubble_fraction(S.compile_1f1b(m, s)))
+
+
+def test_bubble_fraction_classic_shapes():
+    # GPipe's forward program reproduces the textbook
+    # (S-1)/(M+S-1); one stage (or one microbatch filling it) has no
+    # bubble at all.
+    assert S.bubble_fraction(S.compile_gpipe(4, 4)) == pytest.approx(
+        3 / 7)
+    assert S.bubble_fraction(S.compile_gpipe(8, 1)) == 0.0
+    assert S.bubble_fraction(S.compile_zb(4, 1)) == 0.0
+
+
+def test_price_program_uses_ledger_conventions():
+    from tpu_p2p.obs import ledger as L
+
+    prog = S.compile_1f1b(2, 4)
+    bill = S.price_program(prog, payload_bytes=1024)
+    assert bill["name"] == "1f1b"
+    assert bill["ticks"] == prog.num_ticks
+    # Two hops per tick (activation fwd ring + gradient bwd ring).
+    assert bill["hops"] == 2 * prog.num_ticks
+    per_hop = L.wire_bytes("ppermute", 4, 1024)
+    assert bill["wire_bytes_total"] == per_hop * bill["hops"]
+    assert bill["bubble_frac"] == pytest.approx(
+        S.bubble_fraction(prog))
+    # Forward-only programs carry activation hops alone.
+    gp = S.price_program(S.compile_gpipe(2, 4), payload_bytes=1024)
+    assert gp["hops"] == S.compile_gpipe(2, 4).num_ticks
+    assert all(r["payload"] == "activation" for r in gp["rows"])
+
+
+# -------------------------------------- IR-vs-legacy executor parity
+
+
+def test_gpipe_program_step_matches_legacy_bitwise():
+    cfg, params, x, target = pipeline_setup(stages=4, m=4)
+    mesh = parity_mesh(("pp",), (4,))
+    placed = PL.place_pipeline_params(params, mesh)
+    p_leg, l_leg = PL.make_pipeline_train_step(mesh, cfg, lr=5e-2)(
+        placed, x, target)
+    p_ir, l_ir = S.make_tick_train_step(
+        mesh, cfg, S.compile_gpipe(4, 4), lr=5e-2)(placed, x, target)
+    assert float(l_ir) == float(l_leg)
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(p_ir[k]), np.asarray(p_leg[k]), err_msg=k)
+
+
+def test_1f1b_program_step_matches_legacy_bitwise():
+    cfg, params, x, target = pipeline_setup(stages=4, m=4)
+    mesh = parity_mesh(("pp",), (4,))
+    placed = PL.place_pipeline_params(params, mesh)
+    p_leg, l_leg = FB.make_pipeline_train_step_1f1b(
+        mesh, cfg, lr=5e-2)(placed, x, target)
+    p_ir, l_ir = S.make_tick_train_step(
+        mesh, cfg, S.compile_1f1b(4, 4), lr=5e-2)(placed, x, target)
+    assert float(l_ir) == float(l_leg)
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(p_ir[k]), np.asarray(p_leg[k]), err_msg=k)
+
+
+def test_interleaved_program_step_matches_legacy_bitwise():
+    cfg, params, x, target = pipeline_setup(stages=4, m=4)
+    mesh = parity_mesh(("pp",), (2,))
+    placed = IL.place_interleaved_params(params, mesh, 2)
+    p_leg, l_leg = IL.make_interleaved_train_step(
+        mesh, cfg, 2, lr=5e-2)(placed, x, target)
+    p_ir, l_ir = S.make_tick_train_step(
+        mesh, cfg, S.compile_interleaved(4, 2, 2), lr=5e-2)(
+        placed, x, target)
+    assert float(l_ir) == float(l_leg)
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(p_ir[k]), np.asarray(p_leg[k]), err_msg=k)
+
+
+@pytest.mark.parametrize("stages,m,b", [(2, 2, 8), (4, 4, 8),
+                                        (5, 3, 6), (4, 8, 8),
+                                        (1, 4, 8)])
+def test_zb_program_step_matches_fused_bitwise(stages, m, b):
+    # The zero-bubble contract: the SPLIT executor (dx-only vjps on
+    # the critical path, params-only vjps at the deferred dW ticks,
+    # cotangents re-read from the gradient stash) reproduces the
+    # fused 1F1B step bitwise — per-stage accumulation order is
+    # preserved, so not one float moves.
+    cfg, params, x, target = pipeline_setup(stages=stages, m=m, b=b)
+    mesh = parity_mesh(("pp",), (stages,))
+    placed = PL.place_pipeline_params(params, mesh)
+    p_f, l_f = FB.make_pipeline_train_step_1f1b(mesh, cfg, lr=5e-2)(
+        placed, x, target)
+    p_z, l_z = S.make_tick_train_step(
+        mesh, cfg, S.compile_zb(m, stages), lr=5e-2)(placed, x,
+                                                     target)
+    assert float(l_z) == float(l_f)
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(p_z[k]), np.asarray(p_f[k]), err_msg=k)
+
+
+def test_zb_program_wave_ship_stays_bitwise():
+    # pp_overlap="wave" is a per-tick lowering choice of the ONE ship
+    # site (chunked_ppermute_compute), not a rewrite: the zb program
+    # under token-chunk waves — pp_chunks=3 against T=8 exercises the
+    # non-divisible zero-pad path — still reproduces the fused step
+    # bitwise.
+    cfg, params, x, target = pipeline_setup(stages=4, m=4)
+    mesh = parity_mesh(("pp",), (4,))
+    placed = PL.place_pipeline_params(params, mesh)
+    p_f, l_f = FB.make_pipeline_train_step_1f1b(mesh, cfg, lr=5e-2)(
+        placed, x, target)
+    p_z, l_z = S.make_tick_train_step(
+        mesh, cfg, S.compile_zb(4, 4), lr=5e-2, pp_overlap="wave",
+        pp_chunks=3)(placed, x, target)
+    assert float(l_z) == float(l_f)
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(p_z[k]), np.asarray(p_f[k]), err_msg=k)
+
+
+# ------------------------------------------- flagship pp_schedule=zb
+
+
+def test_flagship_zb_matches_1f1b_pp2():
+    # The tentpole's flagship contract on a pure-pp mesh: the manual
+    # executor under pp_schedule="zb" (real transformer block per
+    # tick — sp attention, MoE FFN — inside the split vjps) is
+    # bitwise the fused step.
+    assert_flagship_step_parity(
+        parity_mesh(("pp",), (2,)), flagship_cfg(),
+        flagship_cfg(pp_schedule="zb"), one_f1b=True)
+
+
+@pytest.mark.slow  # tier-1 budget: the mesh/remat matrix rides the
+# uncapped full pass; tier-1 keeps the pp2 case + validation below.
+@pytest.mark.parametrize(
+    "names,shape,kw",
+    [(("dp", "pp"), (2, 2), {}), (("tp", "pp"), (2, 2), {}),
+     (("pp",), (4,), dict(stages=4, microbatches=4)),
+     (("dp", "pp"), (2, 2), dict(remat=True)),
+     (("pp",), (2,), dict(seq=17))],
+    ids=["dp2xpp2", "tp2xpp2", "pp4", "remat", "oddseq"])
+def test_flagship_zb_matches_1f1b_meshes(names, shape, kw):
+    # dp x pp (data-sharded carries), tp x pp (tp-varying dW typing),
+    # pp4 (deep drain), remat (checkpointed block inside the split
+    # vjps), and an odd sequence length (padding through the ships).
+    assert_flagship_step_parity(
+        parity_mesh(names, shape), flagship_cfg(**kw),
+        flagship_cfg(**kw, pp_schedule="zb"), one_f1b=True)
+
+
+@pytest.mark.slow
+def test_flagship_zb_composes_with_wave():
+    # zb + wave: the split schedule's two-way ships lower through the
+    # same chunked_ppermute_compute site — compose bitwise.
+    assert_flagship_step_parity(
+        parity_mesh(("pp",), (2,)), flagship_cfg(),
+        flagship_cfg(pp_schedule="zb", pp_overlap="wave",
+                     pp_chunks=2),
+        one_f1b=True)
+
+
+def test_pp_schedule_knob_is_validated():
+    import pytest as _pytest
+
+    from tpu_p2p.config import BenchConfig
+    from tpu_p2p.models import flagship as F
+
+    with _pytest.raises(ValueError, match="pp_schedule"):
+        flagship_cfg(pp_schedule="zero_bubble")
+    with _pytest.raises(ValueError, match="pp_schedule"):
+        BenchConfig(pp_schedule="ZB")
+    assert BenchConfig(pp_schedule="zb").pp_schedule == "zb"
+    # The GPipe autodiff steps reject zb loudly — a zb label there
+    # would silently time the baseline (the strict-knob class).
+    mesh = parity_mesh(("pp",), (2,))
+    with _pytest.raises(ValueError, match="manual 1F1B"):
+        F.make_flagship_train_step(mesh,
+                                   flagship_cfg(pp_schedule="zb"))
+    with _pytest.raises(ValueError, match="manual 1F1B"):
+        F.make_flagship_lm_train_step(
+            mesh, flagship_cfg(pp_schedule="zb", vocab=32))
+    # And the manual executor rejects zb + interleaving (ZB-V is not
+    # this PR).
+    with _pytest.raises(ValueError, match="chunks=1"):
+        F.make_flagship_train_step_1f1b(
+            mesh, flagship_cfg(pp_schedule="zb", stages=4), chunks=2)
+
+
+# ----------------------------------------------------- executor guards
+
+
+def test_executor_validates_program_against_mesh_and_cfg():
+    cfg, params, x, target = pipeline_setup(stages=4, m=4)
+    mesh = parity_mesh(("pp",), (4,))
+    with pytest.raises(ValueError, match="devices"):
+        S.make_tick_train_step(mesh, cfg, S.compile_1f1b(4, 2))
+    with pytest.raises(ValueError, match="microbatches"):
+        S.make_tick_train_step(mesh, cfg, S.compile_1f1b(2, 4))
+    bad = parity_mesh(("dp",), (4,))
+    with pytest.raises(ValueError, match="'pp' axis"):
+        S.make_tick_train_step(bad, cfg, S.compile_1f1b(4, 4))

@@ -24,6 +24,7 @@ from tpu_p2p.config import (
     ISOLATIONS,
     MODES,
     PATTERNS,
+    PP_SCHEDULES,
     TRANSPORTS,
     parse_size,
     parse_sweep,
@@ -127,6 +128,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "token-chunk waves, each chunk's transfer in "
                         "flight under the remaining tick compute; "
                         "no-op at pp=1)")
+    p.add_argument("--pp-schedule", choices=PP_SCHEDULES,
+                   default="1f1b",
+                   help="flagship_step: pipeline tick schedule under "
+                        "the manual executor (zb = zero-bubble dB/dW "
+                        "split — weight-grad ticks fill the 1F1B "
+                        "bubbles, step bitwise vs 1f1b; routes the "
+                        "workload through the manual 1F1B executor)")
     p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
                    help="testing: force CPU platform with N simulated devices")
     p.add_argument("--list-devices", action="store_true",
@@ -169,6 +177,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         tp_overlap=args.tp_overlap,
         ep_overlap=args.ep_overlap,
         pp_overlap=args.pp_overlap,
+        pp_schedule=args.pp_schedule,
     )
 
 

@@ -122,6 +122,15 @@ MODES = ("serialized", "fused", "differential", "device")
 ISOLATIONS = ("full", "submesh")  # SURVEY.md §7 hard part (a)
 DIRECTIONS = ("uni", "bi", "both")
 TRANSPORTS = ("xla", "pallas_dma")
+PP_SCHEDULES = ("1f1b", "zb")
+# Manual-executor pipeline tick schedules (tpu_p2p/models/schedule.py):
+# "1f1b" = the fused-backward 1F1B/interleaved program (the default —
+# bitwise the pre-IR executors); "zb" = the ZB-H1-style zero-bubble
+# split (backward decomposed into input-grad ticks on the critical
+# path and weight-grad ticks filling the warmup/drain bubbles; step
+# stays bitwise vs "1f1b", the schedule just idles less —
+# docs/schedule_ir.md). ONE definition governs the CLI choices,
+# BenchConfig, and FlagshipConfig validation alike, like TRANSPORTS.
 # xla = CollectivePermute programs (the default — every number before
 # round 11 was measured over it); pallas_dma = raw async remote copies
 # (pltpu.make_async_remote_copy kernels, tpu_p2p/parallel/pallas_dma.py)
@@ -197,6 +206,12 @@ class BenchConfig:
     # FlagshipConfig.pp_overlap, see tpu_p2p/parallel/collectives.py
     # chunked_ppermute_compute. No-op at pp=1; other patterns
     # ignore it.
+    pp_schedule: str = "1f1b"  # flagship_step: pipeline tick schedule
+    # under the MANUAL executor ("zb" routes the step through
+    # make_flagship_train_step_1f1b with the zero-bubble dB/dW-split
+    # program — tpu_p2p/models/schedule.py compile_zb; "1f1b" keeps
+    # the default GPipe-autodiff step). Mirrors
+    # FlagshipConfig.pp_schedule; other patterns ignore it.
 
     def __post_init__(self) -> None:
         if self.pattern not in PATTERNS:
@@ -232,6 +247,11 @@ class BenchConfig:
             raise ValueError(
                 f"unknown pp_overlap {self.pp_overlap!r}; expected "
                 "'none' or 'wave'"
+            )
+        if self.pp_schedule not in PP_SCHEDULES:
+            raise ValueError(
+                f"unknown pp_schedule {self.pp_schedule!r}; expected "
+                f"one of {PP_SCHEDULES}"
             )
         if self.transport not in TRANSPORTS:
             raise ValueError(

@@ -118,6 +118,12 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
         interleaved_grads_local,
     )
 
+    if cfg.pp_schedule == "zb" and chunks != 1:
+        raise ValueError(
+            "pp_schedule='zb' supports chunks=1 only (ZB-H1 splits "
+            "the plain 1F1B schedule; interleaved virtual stages stay "
+            "on pp_schedule='1f1b')"
+        )
     if cfg.zero_dp:
         raise ValueError(
             "zero_dp is unsupported with the manual 1F1B step; use the "
@@ -139,7 +145,17 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
             f"chunks ({chunks})"
         )
     s_chunk = cfg.stages // (n * chunks)
-    sched = build_interleaved_schedule(cfg.microbatches, n, chunks)
+    if cfg.pp_schedule == "zb":
+        # The zero-bubble tick program, executed by the unified IR
+        # executor (tpu_p2p/models/schedule.py): bitwise the fused
+        # "1f1b" step — per-stage dW accumulation order is preserved —
+        # with the backward split so weight-grad ticks fill the
+        # schedule's bubbles (docs/schedule_ir.md).
+        from tpu_p2p.models.schedule import compile_zb, lower
+
+        lowered = lower(compile_zb(cfg.microbatches, n))
+    else:
+        sched = build_interleaved_schedule(cfg.microbatches, n, chunks)
     sp, tp, ep = axes.get("sp"), axes.get("tp"), axes.get("ep")
     specs = flagship_param_specs(mesh, cfg)
     n_out = cfg.batch * cfg.seq * cfg.model_dim
@@ -179,11 +195,22 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
         mb = b_loc // cfg.microbatches
         x_mb = x.reshape((cfg.microbatches, mb) + x.shape[1:])
         t_mb = target.reshape((cfg.microbatches, mb) + target.shape[1:])
-        loss_sum, grads = interleaved_grads_local(
-            block_fn, _mse_loss_grad, params, x_mb, t_mb, sched, "pp",
-            chunk_rows=s_chunk, vma_axes=data_axes, dparam_vma=dparam_vma,
-            pp_overlap=cfg.pp_overlap, pp_chunks=cfg.pp_chunks,
-        )
+        if cfg.pp_schedule == "zb":
+            from tpu_p2p.models.schedule import tick_grads_local
+
+            loss_sum, grads = tick_grads_local(
+                block_fn, _mse_loss_grad, params, x_mb, t_mb, lowered,
+                "pp", chunk_rows=s_chunk, vma_axes=data_axes,
+                dparam_vma=dparam_vma, pp_overlap=cfg.pp_overlap,
+                pp_chunks=cfg.pp_chunks,
+            )
+        else:
+            loss_sum, grads = interleaved_grads_local(
+                block_fn, _mse_loss_grad, params, x_mb, t_mb, sched,
+                "pp", chunk_rows=s_chunk, vma_axes=data_axes,
+                dparam_vma=dparam_vma, pp_overlap=cfg.pp_overlap,
+                pp_chunks=cfg.pp_chunks,
+            )
         if data_axes:
             loss_sum = C.psum(loss_sum, data_axes, label="loss_allreduce")
         return _sgd_update(params, grads, lr, n_out), loss_sum / n_out

@@ -122,6 +122,21 @@ class FlagshipConfig:
     pp_chunks: int = 4       # token chunks per wave ship (pp_overlap=
     # "wave"); clamped to the local token count, non-divisible counts
     # zero-padded (padded tokens stay inert — the bubble invariant).
+    pp_schedule: str = "1f1b"  # pipeline tick schedule under the
+    # MANUAL executor (make_flagship_train_step_1f1b):
+    # "1f1b" — the fused-backward interleaved program, bitwise the
+    # pre-IR executor (the default everywhere). "zb" — the
+    # ZB-H1-style zero-bubble split (tpu_p2p/models/schedule.py
+    # compile_zb): each backward tick decomposes into an input-grad
+    # (dx) tick on the inter-stage critical path and a deferred
+    # weight-grad (dW) tick that fills the warmup/drain bubbles —
+    # per-stage dW accumulation stays in microbatch order, so the
+    # step is BITWISE equal to "1f1b"; only the schedule's idle share
+    # shrinks (analytic + measured grading: bench _pp_sched_metrics,
+    # docs/schedule_ir.md). pp=1 degrades to the fused schedule. The
+    # GPipe-autodiff steps (make_flagship_train_step / the LM/optax
+    # steps) reject "zb" — autodiff owns their backward, so a zb
+    # label there would silently time the baseline.
     use_flash: bool = False  # Pallas flash kernel for the attention
     # math, trainable under every sp_strategy: Ulysses sees the full
     # sequence locally (the standalone custom-vjp kernel drops in);
@@ -227,6 +242,16 @@ class FlagshipConfig:
         if self.pp_chunks < 1:
             raise ValueError(
                 f"pp_chunks must be >= 1, got {self.pp_chunks}"
+            )
+        # Strict like the overlap knobs: a typo ("ZB", "zero_bubble")
+        # would silently train the fused schedule while the run's logs
+        # claim zero-bubble. ONE definition with config.py/cli.
+        from tpu_p2p.config import PP_SCHEDULES
+
+        if self.pp_schedule not in PP_SCHEDULES:
+            raise ValueError(
+                f"unknown pp_schedule {self.pp_schedule!r}; expected "
+                f"one of {PP_SCHEDULES}"
             )
         # Strict: a typo'd policy name must fail at config time, not
         # trace deep inside the step builder. hasattr alone is not

@@ -42,6 +42,22 @@ def _sgd_update(params: Params, grads, lr: float, denom: float):
     )
 
 
+def _reject_zb_schedule(cfg: FlagshipConfig) -> None:
+    """The GPipe steps differentiate *through* the schedule scan —
+    autodiff owns their backward, so there is no dB/dW tick to split;
+    a ``pp_schedule="zb"`` run here would silently time the autodiff
+    baseline while its logs claim zero-bubble (the strict-knob class
+    every overlap validation guards). The manual executor
+    (:func:`tpu_p2p.models.flagship_1f1b.make_flagship_train_step_1f1b`)
+    honors the knob."""
+    if cfg.pp_schedule == "zb":
+        raise ValueError(
+            "pp_schedule='zb' requires the manual 1F1B executor "
+            "(make_flagship_train_step_1f1b); the GPipe autodiff "
+            "steps have no backward ticks to split"
+        )
+
+
 def make_flagship_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
     """Jitted ``(params, x, target) → (grads, loss)`` over the mesh.
 
@@ -51,6 +67,7 @@ def make_flagship_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
     come back sharded exactly like the params, so any optimizer's
     elementwise update runs shard-local under ``jit``.
     """
+    _reject_zb_schedule(cfg)
     axes = _mesh_axes(mesh)
     plan = _fsdp_plan(mesh, cfg)
     specs = flagship_param_specs(mesh, cfg)
@@ -112,6 +129,7 @@ def make_flagship_lm_grad_fn(mesh: Mesh, cfg: FlagshipConfig):
     global-sum loss and grads; step builders own the normalization)."""
     if not cfg.vocab:
         raise ValueError("cfg.vocab must be > 0 for the LM step")
+    _reject_zb_schedule(cfg)
     axes = _mesh_axes(mesh)
     plan = _fsdp_plan(mesh, cfg)
     specs = flagship_param_specs(mesh, cfg)

@@ -29,6 +29,10 @@ TPU-first design:
   ``[B, ...]`` batch everywhere — the loss is then typed replicated
   over ``pp`` and counts once in autodiff, same accounting as the tp
   ``psum`` in :mod:`tpu_p2p.models.ring_transformer`.
+
+Round 14: the schedule also compiles to the unified tick IR
+(:func:`tpu_p2p.models.schedule.compile_gpipe`), whose executor runs
+it bitwise-equal to this module's scan (docs/schedule_ir.md).
 """
 
 from __future__ import annotations

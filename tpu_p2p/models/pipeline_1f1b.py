@@ -37,6 +37,13 @@ TPU-first design:
 - Activations hop ``s → s+1`` and gradients ``s+1 → s`` through
   ``ppermute``; a value computed at tick ``t`` is written into the
   receiver's stash at tick ``t + 1`` (the scan carry is the wire).
+
+Round 14: this schedule also compiles to the unified tick IR
+(:func:`tpu_p2p.models.schedule.compile_1f1b` — bitwise the executor
+below), and the zero-bubble variant ``pp_schedule="zb"`` splits each
+backward tick into input-grad + deferred weight-grad ticks there
+(docs/schedule_ir.md). :func:`build_1f1b_schedule` remains the
+reference description of the classic warmup-then-alternate policy.
 """
 
 from __future__ import annotations

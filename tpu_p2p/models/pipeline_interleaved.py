@@ -27,6 +27,13 @@ row ``d·v + c`` holds virtual stage ``d + c·n`` — so ``P('pp', …)``
 contiguously gives device ``d`` exactly its chunks as local rows
 ``[c=0..v)``. :func:`to_device_major` / :func:`from_device_major`
 convert from plain stage order.
+
+Round 14: :func:`build_interleaved_schedule` is also the source the
+unified tick IR compiles from
+(:func:`tpu_p2p.models.schedule.compile_interleaved` — bitwise this
+executor), and the IR's generalized executor extends this module's
+tick body with split-backward (zero-bubble) tables
+(docs/schedule_ir.md).
 """
 
 from __future__ import annotations

@@ -1,0 +1,971 @@
+"""Unified tick-schedule IR + one executor for every pipeline schedule.
+
+Round 14 tentpole (ROADMAP "Unified schedule IR"). The repo grew four
+hand-written pipeline executors — the GPipe scan
+(:mod:`tpu_p2p.models.pipeline`), plain 1F1B and the interleaved
+virtual-stage schedule (:mod:`tpu_p2p.models.pipeline_1f1b` /
+:mod:`~.pipeline_interleaved`), and the two flagship executors riding
+them — so every schedule improvement multiplied code paths (PR 5's
+wave knob had to touch all of them separately). This module factors
+the schedule itself out of the executors:
+
+- **The IR.** A :class:`TickProgram` is an ordered list of
+  :class:`Tick`\\ s, each ``{compute: (kind, device, chunk,
+  microbatch) ops, hops: (payload, edge set)}`` — a pure host-side
+  description, no arrays, no jax. Op kinds: ``fwd``, ``bwd`` (the
+  fused input+weight backward the legacy executors run),
+  ``bwd_input`` (dx only — the pipeline's critical path) and
+  ``bwd_weight`` (dW only — bubble filler), the Qi et al. zero-bubble
+  split (PAPERS.md, arXiv:2401.10241).
+- **Compilers.** :func:`compile_gpipe`, :func:`compile_1f1b`,
+  :func:`compile_interleaved` emit the three legacy schedules as IR
+  programs (1F1B/interleaved reuse the proven greedy builder in
+  ``pipeline_interleaved``, so the tick tables are byte-identical to
+  what the legacy executors run); :func:`compile_zb` emits the new
+  ZB-H1-style schedule — plain 1F1B with the backward split into
+  ``bwd_input`` on the critical path and ``bwd_weight`` ticks filling
+  the warmup/drain bubbles, per-stage dW order kept in microbatch
+  order so the step stays BITWISE equal to the fused executor (the
+  accumulation sequence per stage is unchanged; only *when* each term
+  lands moves).
+- **One executor.** :func:`make_tick_train_step` runs ANY program:
+  forward-only programs execute as a masked ``lax.scan`` whose
+  backward comes from autodiff (the GPipe contract); programs with
+  backward ticks run the manual per-tick ``jax.vjp`` machinery
+  (rematerialized forwards, interval-colored stash — the
+  ``pipeline_interleaved`` design, generalized with split-backward
+  tables). Every stage hop ships through
+  :func:`tpu_p2p.parallel.collectives.chunked_ppermute_compute`, so
+  ``pp_overlap="wave"`` and ``transport="pallas_dma"`` are per-tick
+  lowering choices of the ONE ship site, not executor rewrites
+  (``chunks<=1`` + ``transport="xla"`` is bitwise the legacy one-shot
+  ``ppermute``).
+- **Analytic accounting.** :func:`bubble_fraction` prices a program's
+  idle share under the uniform cost model (``fwd`` = ``bwd_input`` =
+  ``bwd_weight`` = 1, fused ``bwd`` = 2 — the standard
+  backward-costs-twice-the-forward count), and :func:`price_program`
+  prices each tick's hops with the SAME busbw conventions as the
+  collective ledger (:func:`tpu_p2p.obs.ledger.wire_bytes`), so a
+  schedule's transport bill reads in the obs report's units before a
+  single step runs. These are the ``pp_bubble_frac_{1f1b,zb}`` bench
+  headlines (docs/schedule_ir.md has the compiler table and the
+  ZB-H1 diagram).
+
+Why the zero-bubble split stays bitwise (the contract
+tests/test_schedule.py pins): ``jax.vjp`` of the stage block against
+only its input (``bwd_input``) and later against only its params
+(``bwd_weight``, forward rematerialized from the same stashed
+activation and the same stashed incoming gradient) computes exactly
+the arithmetic the fused vjp computes, just partitioned; no sum is
+reassociated because each stage's dW terms still accumulate in
+microbatch order and the loss terms still accumulate at the last
+stage's ``bwd_input`` ticks in microbatch order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpu_p2p.obs import ledger as _ledger
+
+Edge = Tuple[int, int]
+
+# Analytic op costs in forward-units: the fused backward computes both
+# dx and dW against a rematerialized forward (~2x the forward's
+# FLOPs); the split halves each carry one of them. Bubble fractions
+# derived from these are schedule properties, not measurements.
+OP_COST = {
+    "fwd": 1.0,
+    "bwd": 2.0,
+    "bwd_input": 1.0,
+    "bwd_weight": 1.0,
+}
+
+OP_KINDS = tuple(OP_COST)
+
+
+@dataclass(frozen=True)
+class TickOp:
+    """One compute op: ``device`` runs ``kind`` for local chunk
+    ``chunk`` (virtual stage ``device + chunk * devices``) of
+    microbatch ``microbatch``."""
+
+    kind: str
+    device: int
+    chunk: int
+    microbatch: int
+
+
+@dataclass(frozen=True)
+class TickHop:
+    """One collective hop issued this tick: ``payload`` names what
+    rides the wire (``activation`` fwd ships, ``gradient`` bwd
+    ships); ``edges`` is the ``ppermute`` edge set."""
+
+    payload: str
+    edges: Tuple[Edge, ...]
+
+
+@dataclass(frozen=True)
+class Tick:
+    compute: Tuple[TickOp, ...]
+    hops: Tuple[TickHop, ...] = ()
+
+
+@dataclass(frozen=True)
+class TickProgram:
+    """An ordered tick schedule over ``devices`` pp ranks, each
+    holding ``chunks`` local virtual-stage chunks, processing
+    ``microbatches`` microbatches."""
+
+    name: str
+    devices: int
+    chunks: int
+    microbatches: int
+    ticks: Tuple[Tick, ...]
+
+    @property
+    def num_ticks(self) -> int:
+        return len(self.ticks)
+
+    @property
+    def has_backward(self) -> bool:
+        return any(op.kind != "fwd" for t in self.ticks
+                   for op in t.compute)
+
+    @property
+    def has_split_backward(self) -> bool:
+        return any(op.kind in ("bwd_input", "bwd_weight")
+                   for t in self.ticks for op in t.compute)
+
+
+# ------------------------------------------------------------ analysis
+
+
+def bubble_fraction(program: TickProgram) -> float:
+    """Idle share of the program under :data:`OP_COST`: each tick is a
+    device-synchronous barrier costing the most expensive op issued in
+    it, so ``1 - busy/(devices * span)`` is the fraction of
+    device-ticks spent waiting — the pipeline bubble. GPipe's forward
+    program yields the classic ``(S-1)/(M+S-1)``; the zero-bubble
+    split beats fused 1F1B because ``bwd_weight`` ticks fill
+    warmup/drain holes and the gradient wave crosses stages at
+    ``bwd_input`` (1 unit) speed instead of fused-``bwd`` (2 unit)
+    speed."""
+    n = program.devices
+    span = 0.0
+    busy = [0.0] * n
+    for tick in program.ticks:
+        span += max((OP_COST[op.kind] for op in tick.compute),
+                    default=1.0)
+        for op in tick.compute:
+            busy[op.device] += OP_COST[op.kind]
+    if span <= 0:
+        return 0.0
+    return 1.0 - sum(busy) / (n * span)
+
+
+def price_program(program: TickProgram, payload_bytes: int) -> dict:
+    """Analytic transport bill of one program execution, priced with
+    the collective ledger's busbw conventions
+    (:func:`tpu_p2p.obs.ledger.wire_bytes` — per directed link for the
+    permute family): per-tick rows plus totals, the same units
+    ``python -m tpu_p2p obs`` prints for a *measured* run. ``gradient``
+    hops carry float32 cotangents; callers pass the per-payload byte
+    count they care about (the executors ship one microbatch shard per
+    hop)."""
+    rows: List[dict] = []
+    total_wire = 0
+    for i, tick in enumerate(program.ticks):
+        for hop in tick.hops:
+            wire = _ledger.wire_bytes("ppermute", program.devices,
+                                      payload_bytes)
+            rows.append({
+                "tick": i,
+                "payload": hop.payload,
+                "edges": hop.edges,
+                "wire_bytes": wire,
+            })
+            total_wire += wire
+    return {
+        "name": program.name,
+        "ticks": program.num_ticks,
+        "hops": len(rows),
+        "wire_bytes_total": total_wire,
+        "bubble_frac": bubble_fraction(program),
+        "rows": rows,
+    }
+
+
+# ----------------------------------------------------------- compilers
+
+
+def _ring_edges(n: int) -> Tuple[Edge, ...]:
+    return tuple((i, (i + 1) % n) for i in range(n))
+
+
+def _ring_edges_rev(n: int) -> Tuple[Edge, ...]:
+    return tuple(((i + 1) % n, i) for i in range(n))
+
+
+def _chain_edges(n: int) -> Tuple[Edge, ...]:
+    return tuple((i, i + 1) for i in range(n - 1))
+
+
+def compile_gpipe(microbatches: int, devices: int) -> TickProgram:
+    """The GPipe forward schedule as an IR program: tick ``t`` runs
+    stage ``s``'s forward of microbatch ``t - s`` (bubble ticks
+    elsewhere), activations hopping the no-wraparound neighbor edges.
+    The backward is autodiff's mirror — the executor differentiates
+    through the tick scan, exactly the legacy
+    :func:`tpu_p2p.models.pipeline.pipeline_apply_local` contract."""
+    m, n = int(microbatches), int(devices)
+    if m < 1 or n < 1:
+        raise ValueError(f"need microbatches >= 1, devices >= 1; "
+                         f"got {m}, {n}")
+    hops = (TickHop("activation", _chain_edges(n)),) if n > 1 else ()
+    ticks = []
+    for t in range(m + n - 1):
+        ops = tuple(
+            TickOp("fwd", s, 0, t - s)
+            for s in range(n) if 0 <= t - s < m
+        )
+        ticks.append(Tick(compute=ops, hops=hops))
+    return TickProgram(name="gpipe", devices=n, chunks=1,
+                       microbatches=m, ticks=tuple(ticks))
+
+
+def compile_interleaved(microbatches: int, devices: int,
+                        chunks: int) -> TickProgram:
+    """The interleaved (Megatron-style) 1F1B schedule as an IR
+    program, emitted from the SAME greedy builder the legacy executor
+    runs (:func:`tpu_p2p.models.pipeline_interleaved.
+    build_interleaved_schedule`) — so the compiled program's tick
+    tables are byte-identical to the legacy schedule and the executed
+    step is bitwise the legacy step."""
+    from tpu_p2p.models.pipeline_interleaved import (
+        build_interleaved_schedule,
+    )
+
+    m, n, v = int(microbatches), int(devices), int(chunks)
+    sched = build_interleaved_schedule(m, n, v)
+    hops: Tuple[TickHop, ...] = ()
+    if n > 1:
+        hops = (TickHop("activation", _ring_edges(n)),
+                TickHop("gradient", _ring_edges_rev(n)))
+    ticks = []
+    for t in range(sched.num_ticks):
+        ops = []
+        for d in range(n):
+            if sched.f_mb[t, d] >= 0:
+                ops.append(TickOp("fwd", d, int(sched.f_cidx[t, d]),
+                                  int(sched.f_mb[t, d])))
+            if sched.b_mb[t, d] >= 0:
+                ops.append(TickOp("bwd", d, int(sched.b_cidx[t, d]),
+                                  int(sched.b_mb[t, d])))
+        ticks.append(Tick(compute=tuple(ops), hops=hops))
+    return TickProgram(name="interleaved" if v > 1 else "1f1b",
+                       devices=n, chunks=v, microbatches=m,
+                       ticks=tuple(ticks))
+
+
+def compile_1f1b(microbatches: int, devices: int) -> TickProgram:
+    """Plain 1F1B = the ``chunks=1`` degeneration of the interleaved
+    schedule — the same identity the legacy executor uses
+    (:func:`~tpu_p2p.models.pipeline_1f1b.
+    make_pipeline_train_step_1f1b` delegates to the interleaved step
+    with ``chunks=1``), so IR-vs-legacy parity is definitional."""
+    return compile_interleaved(microbatches, devices, 1)
+
+
+def compile_zb(microbatches: int, devices: int) -> TickProgram:
+    """ZB-H1-style zero-bubble 1F1B: the fused backward splits into
+    ``bwd_input`` (dx — the inter-stage critical path) and
+    ``bwd_weight`` (dW — no consumer downstream, so it fills bubbles).
+
+    Greedy per-device policy, one op per device per tick like the
+    legacy builders: warm up with ``min(M, S - s)`` forwards, then
+    cycle F → Bi → W (a ``bwd_weight`` issues right after its
+    ``bwd_input`` when nothing on the critical path is ready —
+    keeping the activation stash 1F1B-shaped); in the drain, the
+    ``bwd_input`` wave crosses one stage per tick (half the fused
+    backward's latency) and the opened holes fill with the deferred
+    ``bwd_weight`` ticks — which is where the bubble shrinks
+    (docs/schedule_ir.md has the diagram).
+
+    Bitwise contract: per stage, ``bwd_weight`` ops issue strictly in
+    microbatch order (FIFO over completed ``bwd_input``\\ s), so the
+    dW accumulation sequence — and therefore the step — is bitwise
+    the fused 1F1B executor's. ``devices == 1`` has no inter-stage
+    critical path to shorten (and no bubble to fill), so the compiler
+    degrades to the fused schedule — the same size-1 degrade contract
+    as every overlap knob.
+    """
+    m, n = int(microbatches), int(devices)
+    if m < 1 or n < 1:
+        raise ValueError(f"need microbatches >= 1, devices >= 1; "
+                         f"got {m}, {n}")
+    if n == 1:
+        prog = compile_1f1b(m, 1)
+        return TickProgram(name="zb", devices=1, chunks=1,
+                           microbatches=m, ticks=prog.ticks)
+    s = n
+    fwd_tick = np.full((s, m), -1, np.int64)
+    bi_tick = np.full((s, m), -1, np.int64)
+    next_f = [0] * s
+    next_bi = [0] * s
+    next_w = [0] * s
+    last_kind = [""] * s
+    warmup = [min(m, s - st) for st in range(s)]
+    ops_at: Dict[int, List[TickOp]] = {}
+
+    t = 0
+    guard = 8 * (m + s) + 16
+    while any(next_w[st] < m for st in range(s)):
+        if t > guard:
+            raise RuntimeError(
+                f"zb schedule did not converge (M={m}, S={s})"
+            )
+        for st in range(s):
+            def f_ready():
+                mb = next_f[st]
+                return mb < m and (
+                    st == 0 or 0 <= fwd_tick[st - 1, mb] < t
+                )
+
+            def b_ready():
+                mb = next_bi[st]
+                if mb >= m:
+                    return False
+                if st < s - 1:
+                    return 0 <= bi_tick[st + 1, mb] < t
+                return 0 <= fwd_tick[st, mb] < t
+
+            def w_avail():
+                return next_w[st] < next_bi[st]
+
+            # Preference order: warmup forwards first (the 1F1B fill);
+            # after a Bi, its W (memory stays 1F1B-shaped) unless the
+            # critical path idles; after a W, feed the pipe (F); after
+            # an F, drain (Bi). Unready preferences fall through, and
+            # W — always "ready" once its Bi ran — is the filler.
+            if next_f[st] < warmup[st]:
+                prefs = ("F", "B", "W")
+            elif last_kind[st] == "B":
+                prefs = ("W", "F", "B")
+            elif last_kind[st] == "W":
+                prefs = ("F", "B", "W")
+            else:
+                prefs = ("B", "W", "F")
+            for k in prefs:
+                if k == "F" and f_ready():
+                    mb = next_f[st]
+                    fwd_tick[st, mb] = t
+                    next_f[st] += 1
+                    last_kind[st] = "F"
+                    ops_at.setdefault(t, []).append(
+                        TickOp("fwd", st, 0, mb))
+                    break
+                if k == "B" and b_ready():
+                    mb = next_bi[st]
+                    bi_tick[st, mb] = t
+                    next_bi[st] += 1
+                    last_kind[st] = "B"
+                    ops_at.setdefault(t, []).append(
+                        TickOp("bwd_input", st, 0, mb))
+                    break
+                if k == "W" and w_avail():
+                    mb = next_w[st]
+                    next_w[st] += 1
+                    last_kind[st] = "W"
+                    ops_at.setdefault(t, []).append(
+                        TickOp("bwd_weight", st, 0, mb))
+                    break
+        t += 1
+
+    hops = (TickHop("activation", _ring_edges(n)),
+            TickHop("gradient", _ring_edges_rev(n)))
+    ticks = tuple(
+        Tick(compute=tuple(ops_at.get(i, ())), hops=hops)
+        for i in range(t)
+    )
+    return TickProgram(name="zb", devices=n, chunks=1,
+                       microbatches=m, ticks=ticks)
+
+
+# ------------------------------------------------------------ lowering
+
+
+@dataclass(frozen=True)
+class LoweredProgram:
+    """Executable form of a :class:`TickProgram`: per-tick int32
+    tables ``[T, devices]`` (−1 = no op) plus interval-colored stash
+    slot counts — the exact table family the legacy interleaved
+    executor runs, extended with ``w_*`` tables for split-backward
+    programs. Forward-only programs carry just the feed/record
+    tables."""
+
+    program: TickProgram
+    forward_only: bool
+    split: bool
+    act_slots: int
+    grad_slots: int
+    fwd_edges: Tuple[Edge, ...]
+    bwd_edges: Tuple[Edge, ...]
+    tables: Dict[str, np.ndarray]
+
+
+def _op_ticks(program: TickProgram):
+    """→ per-virtual-stage op tick tables ``[s_virt, m]`` (−1 where
+    the program never issues the op)."""
+    n, v, m = program.devices, program.chunks, program.microbatches
+    s_virt = n * v
+    fwd = np.full((s_virt, m), -1, np.int64)
+    bwd = np.full((s_virt, m), -1, np.int64)   # bwd or bwd_input
+    wgt = np.full((s_virt, m), -1, np.int64)   # bwd_weight
+    for t, tick in enumerate(program.ticks):
+        for op in tick.compute:
+            sv = op.device + op.chunk * n
+            tbl = {"fwd": fwd, "bwd": bwd, "bwd_input": bwd,
+                   "bwd_weight": wgt}[op.kind]
+            if tbl[sv, op.microbatch] >= 0:
+                raise ValueError(
+                    f"{program.name}: duplicate {op.kind} for virtual "
+                    f"stage {sv} microbatch {op.microbatch}"
+                )
+            tbl[sv, op.microbatch] = t
+    return fwd, bwd, wgt
+
+
+def lower(program: TickProgram) -> LoweredProgram:
+    """Lower an IR program to executor tables.
+
+    Stash slots are interval-colored per device with the SAME
+    deterministic coloring (and the same interval construction order)
+    as the legacy builder
+    (:func:`~tpu_p2p.models.pipeline_1f1b._color_intervals`), so a
+    program compiled from the legacy schedule lowers to the legacy
+    slot assignment exactly — the bitwise IR-vs-executor contract.
+    For split programs the activation lives until its ``bwd_weight``
+    read and the incoming gradient is re-read there too (the last
+    virtual stage's loss gradient is written into the gradient stash
+    at its ``bwd_input`` tick, so the ``bwd_weight`` tick reads every
+    stage's cotangent the same way)."""
+    from tpu_p2p.models.pipeline_1f1b import _color_intervals
+
+    n, v, m = program.devices, program.chunks, program.microbatches
+    s_virt = n * v
+    T = program.num_ticks
+    fwd_edges = next((h.edges for t in program.ticks for h in t.hops
+                      if h.payload == "activation"), ())
+    bwd_edges = next((h.edges for t in program.ticks for h in t.hops
+                      if h.payload == "gradient"), ())
+    fwd_tick, bwd_tick, w_tick = _op_ticks(program)
+
+    if not program.has_backward:
+        if (fwd_tick < 0).any():
+            raise ValueError(f"{program.name}: forward ops missing")
+        feed_mb = np.full((T,), -1, np.int32)
+        out_mb = np.full((T,), -1, np.int32)
+        for mb in range(m):
+            feed_mb[fwd_tick[0, mb]] = mb
+            out_mb[fwd_tick[s_virt - 1, mb]] = mb
+        return LoweredProgram(
+            program=program, forward_only=True, split=False,
+            act_slots=0, grad_slots=0,
+            fwd_edges=tuple(fwd_edges), bwd_edges=(),
+            tables={"feed_mb": feed_mb, "out_mb": out_mb},
+        )
+
+    split = program.has_split_backward
+    if (fwd_tick < 0).any() or (bwd_tick < 0).any():
+        raise ValueError(f"{program.name}: fwd/bwd ops missing")
+    if split and (w_tick < 0).any():
+        raise ValueError(f"{program.name}: bwd_weight ops missing")
+    last_read = w_tick if split else bwd_tick
+
+    # Interval coloring, per device, in the legacy builder's exact
+    # construction order (chunk-major then microbatch).
+    act_slots, grad_slots = 0, 1
+    act_assign: Dict = {}
+    grad_assign: Dict = {}
+    for d in range(n):
+        act_iv: List[Tuple[int, int, object]] = []
+        grad_iv: List[Tuple[int, int, object]] = []
+        for c in range(v):
+            sv = d + c * n
+            for mb in range(m):
+                w = (fwd_tick[sv, mb] if sv == 0
+                     else fwd_tick[sv - 1, mb] + 1)
+                act_iv.append((int(w), int(last_read[sv, mb]),
+                               (sv, mb)))
+                if sv < s_virt - 1:
+                    grad_iv.append((int(bwd_tick[sv + 1, mb] + 1),
+                                    int(last_read[sv, mb]), (sv, mb)))
+                elif split:
+                    # Last virtual stage under the split: the loss
+                    # gradient is stashed at the Bi tick and re-read
+                    # at the W tick.
+                    grad_iv.append((int(bwd_tick[sv, mb]),
+                                    int(w_tick[sv, mb]), (sv, mb)))
+        cnt, assign = _color_intervals(act_iv)
+        act_slots = max(act_slots, cnt)
+        act_assign.update(assign)
+        if grad_iv:
+            cnt, assign = _color_intervals(grad_iv)
+            grad_slots = max(grad_slots, cnt)
+            grad_assign.update(assign)
+
+    tables = {
+        k: np.full((T, n), -1, np.int32)
+        for k in ("f_mb", "f_cidx", "f_slot", "b_mb", "b_cidx",
+                  "b_slot", "recv_slot", "b_gslot", "grecv_slot",
+                  "w_mb", "w_cidx", "w_slot", "w_gslot")
+    }
+    for sv in range(s_virt):
+        d, c = sv % n, sv // n
+        for mb in range(m):
+            slot = act_assign[(sv, mb)]
+            tables["f_mb"][fwd_tick[sv, mb], d] = mb
+            tables["f_cidx"][fwd_tick[sv, mb], d] = c
+            tables["f_slot"][fwd_tick[sv, mb], d] = slot
+            tables["b_mb"][bwd_tick[sv, mb], d] = mb
+            tables["b_cidx"][bwd_tick[sv, mb], d] = c
+            tables["b_slot"][bwd_tick[sv, mb], d] = slot
+            if sv > 0:
+                tables["recv_slot"][fwd_tick[sv - 1, mb] + 1, d] = slot
+            if sv < s_virt - 1:
+                gs = grad_assign[(sv, mb)]
+                tables["b_gslot"][bwd_tick[sv, mb], d] = gs
+                tables["grecv_slot"][bwd_tick[sv + 1, mb] + 1, d] = gs
+            elif split:
+                gs = grad_assign[(sv, mb)]
+                tables["b_gslot"][bwd_tick[sv, mb], d] = gs
+            if split:
+                gs = grad_assign[(sv, mb)]
+                tables["w_mb"][w_tick[sv, mb], d] = mb
+                tables["w_cidx"][w_tick[sv, mb], d] = c
+                tables["w_slot"][w_tick[sv, mb], d] = slot
+                tables["w_gslot"][w_tick[sv, mb], d] = gs
+    return LoweredProgram(
+        program=program, forward_only=False, split=split,
+        act_slots=act_slots, grad_slots=grad_slots,
+        fwd_edges=tuple(fwd_edges), bwd_edges=tuple(bwd_edges),
+        tables=tables,
+    )
+
+
+# ------------------------------------------------------------ executor
+
+
+def _ship(y, axis, edges, wave: bool, pp_chunks: int, transport: str,
+          label: str):
+    """The ONE stage-hop ship site: every hop lowers through
+    :func:`collectives.chunked_ppermute_compute`, so the wave schedule
+    (``chunks > 1``) and the raw-DMA transport are per-tick lowering
+    choices — ``chunks<=1`` + ``"xla"`` is bitwise the one-shot
+    instrumented ``ppermute``."""
+    from tpu_p2p.parallel import collectives as C
+
+    return C.chunked_ppermute_compute(
+        lambda c, _i: c, y, axis, edges, chunk_dim=1,
+        chunks=(pp_chunks if wave else 1), transport=transport,
+        label=label,
+    )
+
+
+def tick_forward_local(block_fn: Callable, params_local, x_mb,
+                       lowered: LoweredProgram, axis: str,
+                       pp_overlap: str = "none", pp_chunks: int = 1,
+                       transport: str = "xla"):
+    """Run a forward-only program — call inside ``shard_map``.
+
+    The IR-driven twin of :func:`tpu_p2p.models.pipeline.
+    pipeline_apply_local`: identical per-tick arithmetic (feed gate,
+    masked block, last-stage record, psum replicate), with the tick's
+    feed/record indices read from the lowered tables instead of
+    recomputed from the tick counter — so the executed values are
+    bitwise the legacy scan's. Differentiable end to end (the GPipe
+    backward contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_p2p.parallel import collectives as C
+
+    n = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    m = x_mb.shape[0]
+    wave = pp_overlap == "wave" and pp_chunks > 1 and n > 1
+    edges = lowered.fwd_edges
+    zero = jax.lax.pcast(jnp.zeros_like(x_mb[0]), (axis,),
+                         to="varying")
+
+    def tick(carry, row):
+        prev_in, outputs = carry
+        feed_t = row["feed_mb"]
+        mb_idx = jnp.clip(feed_t, 0, m - 1)
+        feed = jnp.where(
+            feed_t >= 0,
+            jax.lax.dynamic_index_in_dim(x_mb, mb_idx, 0,
+                                         keepdims=False),
+            zero,
+        )
+        x_in = jnp.where(my == 0, feed, prev_in)
+        y = block_fn(params_local, x_in)
+        if n > 1:
+            y_next = _ship(y, axis, edges, wave, pp_chunks, transport,
+                           label="pp_stage_ship")
+        else:
+            y_next = zero
+        rec_t = row["out_mb"]
+        upd = jax.lax.dynamic_update_index_in_dim(
+            outputs, y, jnp.clip(rec_t, 0, m - 1), 0
+        )
+        outputs = jnp.where((my == n - 1) & (rec_t >= 0), upd, outputs)
+        return (y_next, outputs), None
+
+    outputs0 = jax.lax.pcast(jnp.zeros_like(x_mb), (axis,),
+                             to="varying")
+    rows = {k: jnp.asarray(v) for k, v in lowered.tables.items()}
+    (_, outputs), _ = jax.lax.scan(tick, (zero, outputs0), rows)
+    return C.psum(outputs, axis, label="pp_output_replicate")
+
+
+def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
+                     params_local, x_mb, target_mb,
+                     lowered: LoweredProgram, axis: str,
+                     chunk_rows: int = 1,
+                     vma_axes: Tuple[str, ...] = (),
+                     dparam_vma=None,
+                     pp_overlap: str = "none", pp_chunks: int = 1,
+                     transport: str = "xla"):
+    """Run a backward-carrying program — call inside ``shard_map``.
+
+    The generalized :func:`tpu_p2p.models.pipeline_interleaved.
+    interleaved_grads_local`: the same rematerialized manual-vjp tick
+    body, masked table lookups, and interval-colored stashes, with two
+    build-time extensions —
+
+    - fused programs (``bwd`` ticks) trace the legacy body exactly
+      (``jax.vjp`` over (params, x) per tick, dchunk accumulated at
+      the backward tick) — bitwise the legacy executor;
+    - split programs (``bwd_input``/``bwd_weight``) trace dx-only
+      vjps at ``bwd_input`` ticks (the incoming cotangent — loss grad
+      at the last virtual stage — is written into the gradient stash
+      for the later re-read) and params-only vjps at ``bwd_weight``
+      ticks (forward rematerialized from the still-stashed
+      activation), accumulating each stage's dW in microbatch order —
+      bitwise the fused step, per the module docstring.
+
+    Returns ``(loss_sum replicated over axis, dparams_local)`` — the
+    legacy executor's exact contract (same ``vma_axes`` /
+    ``dparam_vma`` semantics; see its docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_p2p.parallel import collectives as C
+
+    prog = lowered.program
+    n = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    v = prog.chunks
+    m = prog.microbatches
+    wave = pp_overlap == "wave" and pp_chunks > 1 and n > 1
+    split = lowered.split
+
+    mb_shape = x_mb.shape[1:]
+    all_axes = (axis,) + tuple(a for a in vma_axes if a != axis)
+    varying = lambda z: jax.lax.pcast(z, all_axes, to="varying")  # noqa: E731
+    zero_mb = varying(jnp.zeros(mb_shape, x_mb.dtype))
+    x_stash0 = varying(jnp.zeros((lowered.act_slots,) + mb_shape,
+                                 x_mb.dtype))
+    g_stash0 = varying(jnp.zeros((lowered.grad_slots,) + mb_shape,
+                                 jnp.float32))
+    if dparam_vma is None:
+        dparams0 = jax.tree.map(
+            lambda p: varying(jnp.zeros(p.shape, jnp.float32)),
+            params_local,
+        )
+    else:
+        dparams0 = jax.tree.map(
+            lambda p, ax: jax.lax.pcast(
+                jnp.zeros(p.shape, jnp.float32), tuple(ax),
+                to="varying"
+            ),
+            params_local, dparam_vma,
+        )
+
+    def pick(table):
+        return jax.lax.dynamic_index_in_dim(table, my, 0,
+                                            keepdims=False)
+
+    def chunk_of(params, cidx):
+        start = jnp.clip(cidx, 0, v - 1) * chunk_rows
+        return jax.tree.map(
+            lambda p: jax.lax.dynamic_slice_in_dim(p, start,
+                                                   chunk_rows, 0),
+            params,
+        )
+
+    def tick(carry, row):
+        x_stash, g_stash, y_recv, g_recv, dparams, loss_acc = carry
+
+        rs = pick(row["recv_slot"])
+        x_stash = jnp.where(
+            rs >= 0,
+            jax.lax.dynamic_update_index_in_dim(
+                x_stash, y_recv, jnp.clip(rs, 0, lowered.act_slots - 1),
+                0,
+            ),
+            x_stash,
+        )
+        gs_in = pick(row["grecv_slot"])
+        g_stash = jnp.where(
+            gs_in >= 0,
+            jax.lax.dynamic_update_index_in_dim(
+                g_stash, g_recv,
+                jnp.clip(gs_in, 0, lowered.grad_slots - 1), 0,
+            ),
+            g_stash,
+        )
+
+        # Backward (fused) / backward-input (split): remat the chunk's
+        # forward under vjp — against both (params, x) when fused,
+        # against x alone when split (dW has its own tick).
+        b_mb = pick(row["b_mb"])
+        b_on = b_mb >= 0
+        b_cidx = pick(row["b_cidx"])
+        x_saved = jax.lax.dynamic_index_in_dim(
+            x_stash,
+            jnp.clip(pick(row["b_slot"]), 0, lowered.act_slots - 1),
+            0, keepdims=False,
+        )
+        chunk_b = chunk_of(params_local, b_cidx)
+        if split:
+            y_re, vjp_x = jax.vjp(lambda xx: block_fn(chunk_b, xx),
+                                  x_saved)
+        else:
+            y_re, vjp = jax.vjp(block_fn, chunk_b, x_saved)
+        tgt = jax.lax.dynamic_index_in_dim(
+            target_mb, jnp.clip(b_mb, 0, m - 1), 0, keepdims=False,
+        )
+        loss_mb, g_loss = loss_grad_fn(y_re, tgt)
+        b_gslot = jnp.clip(pick(row["b_gslot"]), 0,
+                           lowered.grad_slots - 1)
+        g_mid = jax.lax.dynamic_index_in_dim(g_stash, b_gslot, 0,
+                                             keepdims=False)
+        is_last = (my == n - 1) & (b_cidx == v - 1)
+        g_in = jnp.where(is_last, g_loss, g_mid)
+        if split:
+            # Stash the cotangent actually consumed, so the deferred
+            # bwd_weight tick reads it back: a rewrite-in-place for
+            # mid-pipeline stages (g_in == g_mid there, bitwise) and
+            # the loss gradient's only store for the last stage.
+            g_stash = jnp.where(
+                b_on,
+                jax.lax.dynamic_update_index_in_dim(
+                    g_stash, g_in.astype(jnp.float32), b_gslot, 0
+                ),
+                g_stash,
+            )
+            (dx,) = vjp_x(g_in.astype(y_re.dtype))
+        else:
+            dchunk, dx = vjp(g_in.astype(y_re.dtype))
+        b_start = jnp.clip(b_cidx, 0, v - 1) * chunk_rows
+
+        def accum_at(acc, dc, start, on):
+            cur = jax.lax.dynamic_slice_in_dim(acc, start, chunk_rows,
+                                               0)
+            upd = jax.lax.dynamic_update_slice_in_dim(
+                acc, cur + dc.astype(jnp.float32), start, 0
+            )
+            return jnp.where(on, upd, acc)
+
+        if not split:
+            dparams = jax.tree.map(
+                lambda acc, dc: accum_at(acc, dc, b_start, b_on),
+                dparams, dchunk,
+            )
+        loss_acc = loss_acc + jnp.where(
+            b_on & is_last, loss_mb.astype(jnp.float32), 0.0
+        )
+        dx = jnp.where(b_on, dx.astype(jnp.float32), 0.0)
+
+        if split:
+            # Backward-weight: remat the forward from the still-
+            # stashed activation, vjp against the params chunk alone,
+            # cotangent re-read from the gradient stash — the same
+            # arithmetic the fused vjp runs for dW, on a later tick.
+            w_mb = pick(row["w_mb"])
+            w_on = w_mb >= 0
+            w_cidx = pick(row["w_cidx"])
+            x_w = jax.lax.dynamic_index_in_dim(
+                x_stash,
+                jnp.clip(pick(row["w_slot"]), 0,
+                         lowered.act_slots - 1),
+                0, keepdims=False,
+            )
+            g_w = jax.lax.dynamic_index_in_dim(
+                g_stash,
+                jnp.clip(pick(row["w_gslot"]), 0,
+                         lowered.grad_slots - 1),
+                0, keepdims=False,
+            )
+            chunk_w = chunk_of(params_local, w_cidx)
+            y_w, vjp_p = jax.vjp(lambda pp: block_fn(pp, x_w),
+                                 chunk_w)
+            (dchunk_w,) = vjp_p(g_w.astype(y_w.dtype))
+            w_start = jnp.clip(w_cidx, 0, v - 1) * chunk_rows
+            dparams = jax.tree.map(
+                lambda acc, dc: accum_at(acc, dc, w_start, w_on),
+                dparams, dchunk_w,
+            )
+
+        # Forward.
+        f_mb = pick(row["f_mb"])
+        f_on = f_mb >= 0
+        f_cidx = pick(row["f_cidx"])
+        f_slot = jnp.clip(pick(row["f_slot"]), 0,
+                          lowered.act_slots - 1)
+        feed = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(f_mb, 0, m - 1), 0, keepdims=False,
+        )
+        x_in = jnp.where((my == 0) & (f_cidx == 0), feed,
+                         jax.lax.dynamic_index_in_dim(
+                             x_stash, f_slot, 0, keepdims=False))
+        x_stash = jnp.where(
+            f_on,
+            jax.lax.dynamic_update_index_in_dim(x_stash, x_in, f_slot,
+                                                0),
+            x_stash,
+        )
+        y_f = block_fn(chunk_of(params_local, f_cidx), x_in)
+        y_f = jnp.where(f_on, y_f, zero_mb)
+
+        if n > 1:
+            y_next = _ship(y_f, axis, lowered.fwd_edges, wave,
+                           pp_chunks, transport, label="pp_fwd_ship")
+            g_next = _ship(dx, axis, lowered.bwd_edges, wave,
+                           pp_chunks, transport, label="pp_bwd_ship")
+        else:
+            y_next, g_next = y_f, dx
+        return (x_stash, g_stash, y_next, g_next, dparams,
+                loss_acc), None
+
+    carry0 = (x_stash0, g_stash0, zero_mb,
+              varying(jnp.zeros(mb_shape, jnp.float32)), dparams0,
+              varying(jnp.zeros((), jnp.float32)))
+    rows = {k: jnp.asarray(v) for k, v in lowered.tables.items()}
+    (_, _, _, _, dparams, loss_acc), _ = jax.lax.scan(
+        tick, carry0, rows
+    )
+    return C.psum(loss_acc, axis, label="pp_loss_replicate"), dparams
+
+
+def make_tick_train_step(mesh, cfg, program: TickProgram,
+                         block_fn: Optional[Callable] = None,
+                         lr: float = 1e-2,
+                         loss_grad_fn: Optional[Callable] = None,
+                         pp_overlap: str = "none", pp_chunks: int = 1,
+                         transport: str = "xla"):
+    """ONE jitted SGD step for ANY tick program — the executor every
+    schedule compiles to.
+
+    ``cfg`` is a :class:`tpu_p2p.models.pipeline.PipelineConfig`;
+    ``cfg.stages`` must equal ``program.devices * program.chunks`` and
+    the mesh's ``pp`` axis must match ``program.devices``. Forward-only
+    programs (GPipe) differentiate through the tick scan (autodiff
+    owns the backward — matching
+    :func:`~tpu_p2p.models.pipeline.make_pipeline_train_step`'s loss
+    normalization and update bitwise); backward-carrying programs run
+    the manual-vjp tick machinery (matching
+    :func:`~tpu_p2p.models.pipeline_interleaved.
+    make_interleaved_train_step`; params for ``chunks > 1`` programs
+    use the device-major layout —
+    :func:`~tpu_p2p.models.pipeline_interleaved.
+    place_interleaved_params`). ``pp_overlap``/``pp_chunks``/
+    ``transport`` lower every stage hop per tick through
+    ``chunked_ppermute_compute`` — the one ship site."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_p2p.models.pipeline import (
+        _to_microbatches,
+        mlp_block,
+        pp_param_specs,
+    )
+    from tpu_p2p.models.pipeline_1f1b import _mse_loss_grad
+
+    block_fn = block_fn or mlp_block
+    loss_grad_fn = loss_grad_fn or _mse_loss_grad
+    pp = "pp" if "pp" in mesh.axis_names else None
+    if pp is None:
+        raise ValueError("mesh needs a 'pp' axis for pipeline "
+                         "parallelism")
+    if mesh.shape[pp] != program.devices:
+        raise ValueError(
+            f"program compiled for {program.devices} devices; pp axis "
+            f"has {mesh.shape[pp]}"
+        )
+    if cfg.stages != program.devices * program.chunks:
+        raise ValueError(
+            f"cfg.stages ({cfg.stages}) != program devices x chunks "
+            f"({program.devices} x {program.chunks})"
+        )
+    if cfg.microbatches != program.microbatches:
+        raise ValueError(
+            f"cfg.microbatches ({cfg.microbatches}) != program "
+            f"microbatches ({program.microbatches})"
+        )
+    lowered = lower(program)
+
+    if lowered.forward_only:
+        def step(params, x, target):
+            def local_loss(p):
+                x_mb = _to_microbatches(x, cfg.microbatches)
+                y = tick_forward_local(
+                    block_fn, p, x_mb, lowered, pp,
+                    pp_overlap=pp_overlap, pp_chunks=pp_chunks,
+                    transport=transport,
+                )
+                return jnp.sum(
+                    (y.astype(jnp.float32)
+                     - _to_microbatches(target, cfg.microbatches)
+                     .astype(jnp.float32)) ** 2
+                )
+
+            loss, grads = jax.value_and_grad(local_loss)(params)
+            denom = float(np.prod(x.shape))
+            new_params = jax.tree.map(
+                lambda p, g: (p.astype(jnp.float32)
+                              - lr * g / denom).astype(p.dtype),
+                params, grads,
+            )
+            return new_params, loss / denom
+    else:
+        def step(params, x, target):
+            x_mb = _to_microbatches(x, cfg.microbatches)
+            t_mb = _to_microbatches(target, cfg.microbatches)
+            loss_sum, grads = tick_grads_local(
+                block_fn, loss_grad_fn, params, x_mb, t_mb, lowered,
+                pp, pp_overlap=pp_overlap, pp_chunks=pp_chunks,
+                transport=transport,
+            )
+            denom = float(np.prod(x.shape))
+            new_params = jax.tree.map(
+                lambda p, g: (p.astype(jnp.float32)
+                              - lr * g / denom).astype(p.dtype),
+                params, grads,
+            )
+            return new_params, loss_sum / denom
+
+    sm = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(pp_param_specs(mesh), P(), P()),
+        out_specs=(pp_param_specs(mesh), P()),
+    )
+    return jax.jit(sm)

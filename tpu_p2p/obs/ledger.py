@@ -430,7 +430,13 @@ def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
     one-hop-per-tick ``pp_stage_ship`` ``ppermute`` rows on the ``pp``
     axis (mode ``"none"``) and the wave decomposition's token-chunk
     rows (mode ``"wave"`` — ``chunked_ppermute_compute``), the
-    round-10 coverage closing the overlap quartet.
+    round-10 coverage closing the overlap quartet — plus a tiny tick-IR
+    train step (:mod:`tpu_p2p.models.schedule`) under BOTH
+    ``pp_schedule`` programs (fused ``1f1b`` and the zero-bubble
+    ``zb`` split), so the report prices the manual executors' two-way
+    stage transport: the ``pp_fwd_ship`` / ``pp_bwd_ship`` rows a ZB
+    run issues land in the ledger like any training step's (the
+    round-14 coverage — ``python -m tpu_p2p obs`` prices ZB hops).
     → ``(ledger, TraceJoin)``; on a 1-device mesh (no link
     exists) the ledger is empty and the join is empty too — but NOT
     marked ``no_device_track``: that flag means the platform records
@@ -481,6 +487,19 @@ def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
                                  pp_chunks=2)
         for mode in ("none", "wave")
     ]
+    # The tick-IR pricing workload (round 14): one SGD step of the
+    # unified executor under the fused 1F1B program AND the
+    # zero-bubble split, so the manual executors' two-way stage
+    # transport (pp_fwd_ship activation hops + pp_bwd_ship gradient
+    # hops — what a pp_schedule="zb" training run issues) is priced
+    # from the same capture.
+    from tpu_p2p.models import schedule as SCH
+
+    sched_t = jnp.ones_like(pp_x)
+    sched_steps = [
+        SCH.make_tick_train_step(pp_mesh, pp_cfg, prog)
+        for prog in (SCH.compile_1f1b(2, n), SCH.compile_zb(2, n))
+    ]
     # The Pallas raw-DMA ring twin (round 11): the same shift-by-1
     # edges over `transport="pallas_dma"` when the capability probe
     # passes, so the report prices BOTH transports from one capture
@@ -507,6 +526,8 @@ def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
             jax.block_until_ready(layer(params, moe_x))
         for fwd in pp_fwds:
             jax.block_until_ready(fwd(pp_params, pp_x))
+        for stp in sched_steps:
+            jax.block_until_ready(stp(pp_params, pp_x, sched_t))
     with tempfile.TemporaryDirectory(prefix="obs_cap_") as td:
         with jax.profiler.trace(td):
             jax.block_until_ready(ring(payload))
@@ -517,6 +538,9 @@ def live_capture(mesh, msg_bytes: int = 4 * 1024 * 1024,
                 jax.block_until_ready(layer(params, moe_x))
             for fwd in pp_fwds:
                 jax.block_until_ready(fwd(pp_params, pp_x))
+            for stp in sched_steps:
+                jax.block_until_ready(stp(pp_params, pp_x,
+                                          sched_t))
         join = join_trace(led, td)
     return led, join
 

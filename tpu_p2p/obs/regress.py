@@ -78,11 +78,13 @@ TOLERANCES: Dict[str, Tolerance] = {
     # line no longer carries would SKIP forever — dead config by this
     # module's own rule (tests/test_obs_regress.py pins tolerance ⊆
     # headline). The values still measure into BENCH_detail.json.
-    "flagship_step_ms": Tolerance("lower", 0.20),
+    # Round 14 applied the same rule to flagship_step_ms,
+    # decode_ms_per_token, obs_step_ms_p99, and
+    # serve_tokens_per_s_static — the compact line traded them for
+    # the schedule-IR quartet below (bench.py HEADLINE_KEYS note).
     "flagship_large_step_ms": Tolerance("lower", 0.15),
     "flagship_large_mfu": Tolerance("higher", 0.10),
     "latency_8b_p50_us": Tolerance("lower", 0.50),
-    "decode_ms_per_token": Tolerance("lower", 0.25),
     "fsdp_overlap_frac": Tolerance("higher", 0.25),
     "fsdp_step_ms_overlap_prefetch": Tolerance("lower", 0.25),
     "tp_overlap_frac": Tolerance("higher", 0.25),
@@ -92,6 +94,17 @@ TOLERANCES: Dict[str, Tolerance] = {
     # PR 5 pp-wave keys (bench.py _pp_overlap_metrics).
     "pp_overlap_frac": Tolerance("higher", 0.25),
     "pp_step_ms_overlap_wave": Tolerance("lower", 0.25),
+    # PR 9 schedule-IR keys (bench.py _pp_sched_metrics). The bubble
+    # fractions are ANALYTIC — pure properties of the compiled tick
+    # programs at the fixed canonical shape, identical round over
+    # round unless the schedule itself changes — so their tolerance
+    # only exists to catch a schedule regression (a zb compiler edit
+    # that re-opens the bubble). The measured step times ride the
+    # same manual-executor machinery as the overlap step keys (25%).
+    "pp_bubble_frac_1f1b": Tolerance("lower", 0.25),
+    "pp_bubble_frac_zb": Tolerance("lower", 0.25),
+    "pp_step_ms_sched_1f1b": Tolerance("lower", 0.25),
+    "pp_step_ms_sched_zb": Tolerance("lower", 0.25),
     # PR 3 obs keys (bench.py _obs_metrics).
     "ring_achieved_gbps": Tolerance("higher", 0.25),
     "obs_step_ms_p50": Tolerance("lower", 0.30),
@@ -111,16 +124,14 @@ TOLERANCES: Dict[str, Tolerance] = {
     # real gating (any delta <= 0.05 passes; the smoke's own relative
     # gate is stricter), because one lucky near-cancellation round
     # would otherwise min-ratchet an unpassable reference.
-    "obs_step_ms_p99": Tolerance("lower", 0.50),
     "health_detect_steps": Tolerance("lower", 1.00),
     "heal_resume_loss_delta": Tolerance("lower", 1.00, abs_floor=0.05),
-    # PR 8 serving-engine keys (bench.py _serve_metrics). The two
-    # tokens/s numbers ride the device-trace replay slope (25%, like
+    # PR 8 serving-engine keys (bench.py _serve_metrics). The
+    # tokens/s number rides the device-trace replay slope (25%, like
     # the achieved-Gbps family); the request-latency tails ride the
     # real host loop — the jitteriest family (50%, like the 8 B
-    # latency floors and obs_step_ms_p99).
+    # latency floors).
     "serve_tokens_per_s": Tolerance("higher", 0.25),
-    "serve_tokens_per_s_static": Tolerance("higher", 0.25),
     "serve_ttft_ms_p50": Tolerance("lower", 0.50),
     "serve_tok_ms_p99": Tolerance("lower", 0.50),
 }

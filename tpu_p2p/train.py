@@ -672,6 +672,16 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pp-chunks", type=int, default=4,
                    help="token chunks per wave stage hop "
                         "(--pp-overlap wave)")
+    from tpu_p2p.config import PP_SCHEDULES
+
+    p.add_argument("--pp-schedule", default="1f1b",
+                   choices=PP_SCHEDULES,
+                   help="pipeline tick schedule (zb = the zero-bubble "
+                        "dB/dW split, manual-executor only — the "
+                        "training loop runs GPipe autodiff and "
+                        "rejects it with a pointer at "
+                        "make_flagship_train_step_1f1b / the "
+                        "flagship_step workload)")
     return p
 
 
@@ -703,6 +713,7 @@ def main(argv=None) -> int:
         remat=args.remat, zero_dp=args.zero_dp, overlap=args.overlap,
         tp_overlap=args.tp_overlap, ep_overlap=args.ep_overlap,
         pp_overlap=args.pp_overlap, pp_chunks=args.pp_chunks,
+        pp_schedule=args.pp_schedule,
     )
     fault_plan = None
     if (args.fault_degrade_edge or args.fault_slow_rank is not None

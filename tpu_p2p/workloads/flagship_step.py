@@ -55,13 +55,27 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
         # collectives.chunked_ppermute_compute); degrades to the
         # one-shot ppermute on pp=1 meshes.
         mc = dataclasses.replace(mc, pp_overlap=cfg.pp_overlap)
-    # mc as the placement cfg: with zero_dp the param specs carry the
-    # ZeRO dp dim, and placing without it would materialize full
-    # replicas (the memory ZeRO exists to avoid) + a first-step
-    # reshard.
-    params = F.place_flagship_params(F.init_flagship_params(mc), mesh, mc)
+    if model_cfg is None and cfg.pp_schedule != "1f1b":
+        # --pp-schedule zb: the zero-bubble dB/dW tick program
+        # (tpu_p2p/models/schedule.py compile_zb). The knob lives on
+        # the MANUAL executor, so the workload routes through it
+        # below; the step stays bitwise vs the fused schedule.
+        mc = dataclasses.replace(mc, pp_schedule=cfg.pp_schedule)
+    host_params = F.init_flagship_params(mc)
+    if mc.pp_schedule != "1f1b":
+        # The manual (interleaved-machinery) executor owns tick
+        # schedules: device-major param layout + per-tick jax.vjp
+        # (tpu_p2p/models/flagship_1f1b.py).
+        params = F.place_flagship_params_pipelined(host_params, mesh, mc)
+        step = F.make_flagship_train_step_1f1b(mesh, mc)
+    else:
+        # mc as the placement cfg: with zero_dp the param specs carry
+        # the ZeRO dp dim, and placing without it would materialize
+        # full replicas (the memory ZeRO exists to avoid) + a
+        # first-step reshard.
+        params = F.place_flagship_params(host_params, mesh, mc)
+        step = F.make_flagship_train_step(mesh, mc)
     x, t = F.flagship_example_batch(mc, mesh)
-    step = F.make_flagship_train_step(mesh, mc)
 
     state = {"params": params}
 
@@ -88,11 +102,13 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
                    if mc.ep_overlap != "none" else "")
         pp_part = (f" pp_overlap={mc.pp_overlap}"
                    if mc.pp_overlap != "none" else "")
+        sched_part = (f" pp_schedule={mc.pp_schedule}"
+                      if mc.pp_schedule != "1f1b" else "")
         sys.stdout.write(
             f"flagship_step mesh {axes} {mc.sp_strategy}-SP "
             f"B{mc.batch} T{mc.seq} H{mc.heads} E{mc.num_experts} "
             f"S{mc.stages}x{mc.microbatches}mb {mc.dtype}"
-            f"{tp_part}{ep_part}{pp_part}: "
+            f"{tp_part}{ep_part}{pp_part}{sched_part}: "
             f"p50 {s.p50 * 1e3:.2f}ms/step  {tok_s:,.0f} tokens/s\n"
         )
         sys.stdout.flush()
@@ -103,7 +119,7 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
             mesh=str(axes), sp_strategy=mc.sp_strategy,
             batch=mc.batch, seq=mc.seq, tokens_per_s=tok_s,
             tp_overlap=mc.tp_overlap, ep_overlap=mc.ep_overlap,
-            pp_overlap=mc.pp_overlap,
+            pp_overlap=mc.pp_overlap, pp_schedule=mc.pp_schedule,
         )
     )
     return {"mesh": axes, "p50_ms": s.p50 * 1e3, "tokens_per_s": tok_s}
