@@ -17,7 +17,7 @@ SHELL    := /bin/bash
 
 NATIVE_SO := native/libtpu_p2p_native.so
 
-.PHONY: all native run test tier1 bench obs health serve clean
+.PHONY: all native run test tier1 bench obs health serve serve-chaos clean
 
 all: native
 
@@ -68,6 +68,16 @@ health:
 # runs anywhere; override with ARGS= on real hardware.
 serve:
 	$(PYTHON) -m tpu_p2p serve $(if $(ARGS),$(ARGS),--cpu-mesh 8)
+
+# Serving-resilience chaos smoke (docs/serving_resilience.md): three
+# injected fault scenarios — page-pool clamp → preemption with zero
+# completed-token loss + paged-vs-dense parity, request storm → shed
+# verdicts within the step bound, slow host → bitwise schedule
+# invariance — graded the way `make health` grades training; nonzero
+# exit unless all three pass. Defaults to the simulated 8-device CPU
+# mesh; override with ARGS= on real hardware.
+serve-chaos:
+	$(PYTHON) -m tpu_p2p serve --chaos $(if $(ARGS),$(ARGS),--cpu-mesh 8)
 
 # `make train ARGS="--steps 100 --ckpt-dir runs/a"` — the training
 # loop (tpu_p2p/train.py): loader + step + checkpoint/resume + JSONL.

@@ -77,11 +77,9 @@ HEADLINE_KEYS = (
     "ep_step_ms_overlap_ring",
     "pp_overlap_frac",
     "pp_step_ms_overlap_wave",
-    "pp_bubble_frac_1f1b",
     "pp_bubble_frac_zb",
     "pp_step_ms_sched_1f1b",
     "pp_step_ms_sched_zb",
-    "ring_achieved_gbps",
     "obs_step_ms_p50",
     "health_detect_steps",
     "heal_resume_loss_delta",
@@ -92,6 +90,8 @@ HEADLINE_KEYS = (
     "serve_tokens_per_s",
     "serve_ttft_ms_p50",
     "serve_tok_ms_p99",
+    "serve_preempt_recover_steps",
+    "serve_shed_frac_overload",
     # min_gbps/max_gbps retired from the compact line in round 10 (the
     # pp_* keys took their bytes): they were the designed drop-first
     # tail — never graded, never gated (obs/regress.py TOLERANCES),
@@ -132,6 +132,18 @@ HEADLINE_KEYS = (
     # (the p50 twin stays as the cadence sentinel; the tail persists
     # in BENCH_detail.json and the serve_tok_ms_p99 key still grades
     # a host-loop p99). test_round14_budget_trade pins the move.
+    # Round 15 applied the same rule to two more to make room for the
+    # serve-resilience pair serve_preempt_recover_steps /
+    # serve_shed_frac_overload: ring_achieved_gbps (byte-equivalent
+    # twin of ring_gbps_xla since the round-11 head-to-head — same
+    # ring busbw over the same XLA transport; the dma pair stays as
+    # the graded sentinel) and pp_bubble_frac_1f1b (an ANALYTIC
+    # CONSTANT of the fused schedule at the fixed canonical shape —
+    # the graded claim, zb < 1f1b, is enforced inside
+    # _pp_sched_metrics and pp_bubble_frac_zb stays). Both still
+    # measure into BENCH_detail.json; their tolerances retired per
+    # the gate's tolerance-⊆-headline rule. test_round15_budget_trade
+    # pins the move.
 )
 
 
@@ -1524,6 +1536,61 @@ def _serve_metrics(timing):
     return out
 
 
+# Null shape of _serve_resilience_metrics — failure must produce the
+# same keys (schema stability), serve_resil_error naming WHY.
+RESIL_NULL = {
+    "serve_resil_devices": None,
+    "serve_preempt_recover_steps": None,
+    "serve_shed_frac_overload": None,
+    "serve_preemptions": None,
+    "serve_shed_count": None,
+    "serve_chaos_ok": None,
+    "serve_resil_error": None,
+}
+
+
+def _serve_resilience_metrics(timing):
+    """Serving-resilience chaos grades (round 15 tentpole —
+    tpu_p2p/serve/resilience.py, docs/serving_resilience.md).
+
+    Runs the same three injected-fault scenarios as ``python -m
+    tpu_p2p serve --chaos`` (page-pool clamp → preemption, request
+    storm → shedding, slow host → schedule invariance) on the current
+    mesh and publishes the two deterministic gate numbers:
+
+    ``serve_preempt_recover_steps``: worst steps from a preemption to
+    the victim's next emitted token — pure schedule arithmetic
+    (step-indexed, host-speed-independent), so the gate sees a
+    scheduler regression, not wall noise. ``serve_shed_frac_overload``:
+    the fraction of the storm scenario's requests shed by admission
+    control + deadlines — equally schedule-deterministic. A scenario
+    that fails to grade nulls its key with the reason in
+    ``serve_resil_error`` (the HEALTH_NULL convention).
+    """
+    from tpu_p2p.serve.resilience import run_chaos
+
+    out = dict(RESIL_NULL)
+    # Stream scenario progress to stderr as it happens (the
+    # _health_metrics convention): a mid-scenario crash must leave
+    # the lines that already printed, or the null schema becomes
+    # undiagnosable from bench output.
+    res = run_chaos(out=sys.stderr)
+    out["serve_resil_devices"] = res["devices"]
+    out["serve_preempt_recover_steps"] = \
+        res["serve_preempt_recover_steps"]
+    out["serve_shed_frac_overload"] = res["serve_shed_frac_overload"]
+    out["serve_preemptions"] = res["preempt_clamp"]["preemptions"]
+    out["serve_shed_count"] = res["storm_shed"]["shed"]
+    out["serve_chaos_ok"] = res["ok"]
+    if not res["ok"]:
+        out["serve_resil_error"] = (
+            "chaos scenarios incomplete: "
+            + json.dumps({s: res[s].get("ok")
+                          for s in ("preempt_clamp", "storm_shed",
+                                    "slow_step") if s in res}))
+    return out
+
+
 def _decode_chain_slope(timing, max_len: int, iters: int = 512,
                         repeats: int = 6):
     """Shared decode-chain measurement: device-trace slope of a scan
@@ -2401,6 +2468,16 @@ def main() -> int:
         print(f"# serve measurement failed: {e!r}", file=sys.stderr)
         serve_m = {"serve_error": f"{type(e).__name__}: {e}"}
     result["detail"].update({k: serve_m.get(k) for k in SERVE_NULL})
+    # Serving resilience chaos (round-15 tentpole): preemption
+    # recovery + overload shed fraction off the injected-fault
+    # scenarios, RESIL_NULL schema (with the reason) on failure.
+    try:
+        resil_m = _serve_resilience_metrics(timing)
+    except Exception as e:  # noqa: BLE001 — same rationale
+        print(f"# serve resilience chaos failed: {e!r}",
+              file=sys.stderr)
+        resil_m = {"serve_resil_error": f"{type(e).__name__}: {e}"}
+    result["detail"].update({k: resil_m.get(k) for k in RESIL_NULL})
 
     detail_path = _detail_path()
     try:

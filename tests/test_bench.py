@@ -318,6 +318,13 @@ def test_main_multichip_branch_schema(capsys, monkeypatch, tmp_path):
         bench, "_health_metrics",
         lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
     )
+    # The chaos smoke runs three full engine traces — real coverage
+    # lives in tests/test_serve_resilience.py; here exercise the
+    # failure wiring (explicit nulls, schema intact).
+    monkeypatch.setattr(
+        bench, "_serve_resilience_metrics",
+        lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
+    )
     compact, r = _run_main(capsys, monkeypatch, tmp_path)
     assert compact["metric"] == r["metric"]
     assert compact["value"] == r["value"]
@@ -410,6 +417,8 @@ def test_main_multichip_bad_env_falls_back(capsys, monkeypatch, tmp_path):
     monkeypatch.setattr(bench, "_pp_sched_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_obs_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_health_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_serve_resilience_metrics",
+                        lambda t: {})
     _, r = _run_main(capsys, monkeypatch, tmp_path)
     # Fell back to the default 24-pair cap: ceil-stride over the 56
     # ordered pairs of an 8-device mesh measures 19 of them.
@@ -436,6 +445,8 @@ def test_main_multichip_device_sourced_cells(capsys, monkeypatch,
     monkeypatch.setattr(bench, "_pp_sched_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_obs_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_health_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_serve_resilience_metrics",
+                        lambda t: {})
     _, r = _run_main(capsys, monkeypatch, tmp_path)
     d = r["detail"]
     assert d["headline_source"] == "device_trace"
@@ -534,6 +545,13 @@ def test_main_single_chip_branch_schema(capsys, monkeypatch, tmp_path):
     )
     monkeypatch.setattr(
         bench, "_health_metrics",
+        lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
+    )
+    # The chaos smoke runs three full engine traces — real coverage
+    # lives in tests/test_serve_resilience.py; here exercise the
+    # failure wiring (explicit nulls, schema intact).
+    monkeypatch.setattr(
+        bench, "_serve_resilience_metrics",
         lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
     )
     monkeypatch.setattr(
@@ -677,6 +695,8 @@ def test_single_chip_headline_vs_baseline_uses_device_kind(capsys,
     monkeypatch.setattr(bench, "_pp_sched_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_obs_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_health_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_serve_resilience_metrics",
+                        lambda t: {})
     monkeypatch.setattr(bench, "_serve_metrics", lambda t: {})
     monkeypatch.setattr(
         bench, "_loopback_size_sweep", lambda *a, **kw: [])
@@ -938,12 +958,13 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         # serve_tokens_per_s_static, flagship_step_ms,
         # decode_ms_per_token, and obs_step_ms_p99 moved to
         # BENCH_detail.json to make room (test_round14_budget_trade
-        # pins the move).
-        "pp_bubble_frac_1f1b": 0.4286,
+        # pins the move). Round 15 traded pp_bubble_frac_1f1b (the
+        # fused schedule's analytic constant) and ring_achieved_gbps
+        # (ring_gbps_xla's byte-equivalent twin) for the serve
+        # resilience pair (test_round15_budget_trade).
         "pp_bubble_frac_zb": 0.1905,
         "pp_step_ms_sched_1f1b": 98.765,
         "pp_step_ms_sched_zb": 98.765,
-        "ring_achieved_gbps": 1234.56,
         "obs_step_ms_p50": 123.456,
         # Round 12: the health pair joined the line; "devices" (the
         # byte-identical twin of the line's own top-level "n") and
@@ -970,6 +991,10 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         "serve_tokens_per_s": 533333,
         "serve_ttft_ms_p50": 1234.567,
         "serve_tok_ms_p99": 123.456,
+        # Round 15: the serve-resilience chaos pair (bench.py
+        # _serve_resilience_metrics).
+        "serve_preempt_recover_steps": 12,
+        "serve_shed_frac_overload": 0.4861,
     }
     # Every headline key must have a realistic value in this test —
     # a key added to HEADLINE_KEYS without extending this table would
@@ -1026,13 +1051,15 @@ def test_obs_headline_keys_survive_compact_budget():
     # test_compact_line_fits_with_every_headline_key_at_realistic_width;
     # this asserts the obs keys specifically survive).
     # ag_achieved_gbps left the line in the round-13 budget trade
-    # (test_round13_budget_trade) — ring stays as the sentinel.
-    new = ("ring_achieved_gbps", "obs_step_ms_p50")
+    # (test_round13_budget_trade); ring_achieved_gbps followed in
+    # round 15 (test_round15_budget_trade — ring_gbps_xla is its
+    # byte-equivalent graded twin), leaving the step cadence as the
+    # obs sentinel.
+    new = ("obs_step_ms_p50",)
     for k in new:
         assert k in bench.HEADLINE_KEYS, k
     detail = {
         "devices": 256,
-        "ring_achieved_gbps": 1234.56,
         "obs_step_ms_p50": 123.456,
     }
     result = {
@@ -1176,10 +1203,38 @@ def test_round14_budget_trade():
     assert "serve_tokens_per_s_static" in bench.SERVE_NULL
     assert "obs_step_ms_p99" in bench.OBS_NULL
     assert "decode_ms_per_token" in bench.DECODE_NULL
-    for k in ("pp_bubble_frac_1f1b", "pp_bubble_frac_zb",
+    # pp_bubble_frac_1f1b joined the line in round 14 and left it
+    # again in the round-15 trade (test_round15_budget_trade).
+    for k in ("pp_bubble_frac_zb",
               "pp_step_ms_sched_1f1b", "pp_step_ms_sched_zb"):
         assert k in bench.HEADLINE_KEYS, k
         assert k in bench.SCHED_NULL, k
+        assert k in TOLERANCES, k
+
+
+def test_round15_budget_trade():
+    # The round-15 budget trade, pinned like the round-13/14 ones:
+    # two keys left the compact line for the serve-resilience pair
+    # but still measure into BENCH_detail.json. ring_achieved_gbps
+    # has been the byte-equivalent twin of ring_gbps_xla since the
+    # round-11 head-to-head (same ring busbw over the same XLA
+    # transport — the dma pair stays graded); pp_bubble_frac_1f1b is
+    # an analytic CONSTANT of the fused schedule at the fixed
+    # canonical shape (zb < 1f1b is enforced inside _pp_sched_metrics
+    # and the zb fraction stays graded). Tolerances retired WITH them
+    # per the gate's tolerance-⊆-headline rule.
+    from tpu_p2p.obs.regress import TOLERANCES
+
+    gone = ("ring_achieved_gbps", "pp_bubble_frac_1f1b")
+    for k in gone:
+        assert k not in bench.HEADLINE_KEYS, k
+        assert k not in TOLERANCES, k
+    assert "ring_achieved_gbps" in bench.OBS_NULL
+    assert "pp_bubble_frac_1f1b" in bench.SCHED_NULL
+    for k in ("serve_preempt_recover_steps",
+              "serve_shed_frac_overload"):
+        assert k in bench.HEADLINE_KEYS, k
+        assert k in bench.RESIL_NULL, k
         assert k in TOLERANCES, k
 
 
@@ -1286,6 +1341,73 @@ def test_serve_headline_keys_survive_compact_budget():
     head = json.loads(s)["headline"]
     for k in new:
         assert k in head, k
+
+
+def test_serve_resilience_headline_keys_survive_compact_budget():
+    # Satellite contract (round 15): the chaos pair rides the ≤1 KiB
+    # compact line at realistic widths (the general full-schema pin
+    # covers the fully-populated line; this asserts the pair
+    # specifically survives).
+    new = ("serve_preempt_recover_steps", "serve_shed_frac_overload")
+    for k in new:
+        assert k in bench.HEADLINE_KEYS, k
+    detail = {
+        "devices": 256,
+        "serve_preempt_recover_steps": 12,
+        "serve_shed_frac_overload": 0.4861,
+    }
+    result = {
+        "metric": "all_pairs_unidir_bandwidth_avg", "value": 1234.567,
+        "unit": "Gbps", "vs_baseline": 0.7716, "detail": detail,
+    }
+    s = bench._compact_line(result, "BENCH_detail.json")
+    assert len(s.encode()) <= bench.COMPACT_LINE_MAX_BYTES
+    head = json.loads(s)["headline"]
+    for k in new:
+        assert k in head, k
+
+
+def test_serve_resilience_metrics_wiring(monkeypatch):
+    # The round-15 gate numbers plumb straight out of run_chaos (the
+    # real injected-fault matrix is tests/test_serve_resilience.py's
+    # end-to-end + the serve_chaos golden; bench must only relay).
+    # A failing chaos ("ok": False) nulls the graded keys AND names
+    # the broken scenario — the HEALTH_NULL convention.
+    import tpu_p2p.serve.resilience as resil_mod
+
+    from tpu_p2p.utils import timing
+
+    good = {
+        "devices": 8, "ok": True,
+        "serve_preempt_recover_steps": 5,
+        "serve_shed_frac_overload": 0.45,
+        "preempt_clamp": {"preemptions": 2, "ok": True},
+        "storm_shed": {"shed": 21, "ok": True},
+        "slow_step": {"ok": True},
+    }
+    monkeypatch.setattr(resil_mod, "run_chaos",
+                        lambda out: good)
+    out = bench._serve_resilience_metrics(timing)
+    assert set(out) == set(bench.RESIL_NULL)
+    assert out["serve_resil_devices"] == 8
+    assert out["serve_preempt_recover_steps"] == 5
+    assert out["serve_shed_frac_overload"] == 0.45
+    assert out["serve_preemptions"] == 2
+    assert out["serve_shed_count"] == 21
+    assert out["serve_chaos_ok"] is True
+    assert out["serve_resil_error"] is None
+
+    bad = dict(good, ok=False,
+               serve_preempt_recover_steps=None,
+               serve_shed_frac_overload=None)
+    bad["storm_shed"] = {"shed": 0, "ok": False}
+    monkeypatch.setattr(resil_mod, "run_chaos",
+                        lambda out: bad)
+    out = bench._serve_resilience_metrics(timing)
+    assert out["serve_preempt_recover_steps"] is None
+    assert out["serve_shed_frac_overload"] is None
+    assert out["serve_chaos_ok"] is False
+    assert "storm_shed" in out["serve_resil_error"]
 
 
 def test_decode_metrics_null_schema_on_flat_slope(monkeypatch):

@@ -99,6 +99,15 @@ SUMMARY_PATTERNS = {
     # pinned; every wall-derived rate/latency magnitude masks.
     "serve": ["serve", "--cpu-mesh", "8", "--requests", "6",
               "--seed", "0", "--batching", "both"],
+    # The round-15 chaos smoke end to end on the 8-device mesh: three
+    # injected fault scenarios (page-pool clamp → preemption, request
+    # storm → shedding, slow host → schedule invariance) graded like
+    # `make health`. Preempt/shed/step counts, recover steps, and the
+    # scenario verdicts are schedule-deterministic and stay pinned;
+    # every wall-derived second/fraction magnitude masks. _run_cli
+    # asserts rc 0, i.e. ALL THREE scenarios must grade — the
+    # acceptance criterion rides this pin.
+    "serve_chaos": ["serve", "--cpu-mesh", "8", "--chaos"],
     # The round-12 watch subcommand end to end over a checked-in
     # deterministic obs stream (tests/golden/obs_watch_fixture.jsonl):
     # one embedded health verdict re-printed + one straggler re-scored

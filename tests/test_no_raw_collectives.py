@@ -94,9 +94,11 @@ def test_lint_scans_the_expected_trees():
     assert "schedule.py" in names, sorted(names)
     # The round-13 serve tree is covered (paged_cache.py issues the
     # decode psum joins through the wrappers; a regression that drops
-    # serve/ from SCANNED must fail here, not ship silently).
+    # serve/ from SCANNED must fail here, not ship silently). Round
+    # 15's resilience.py rides the same coverage.
     assert "paged_cache.py" in names and "batcher.py" in names, \
         sorted(names)
+    assert "resilience.py" in names, sorted(names)
     assert len(files) >= 18, files
 
 
@@ -133,6 +135,11 @@ def _all_pkg_files():
 # same hole class as a raw collective in model code. Entry points
 # (faults.injecting / maybe_slow_host / host_lost) are fine anywhere
 # — this lint pins the *application* sites.
+# Round 15 added serve/resilience.py to the allowlist: the serve-
+# scoped faults (page-pool clamp, request storm, slow-step hook) are
+# applied there and ONLY there (apply_serve_faults) — a clamp or
+# burst consulted from batcher/engine code would skew serving
+# behavior the chaos grader could never attribute.
 
 _FAULT_CALL = re.compile(
     r"(?:\bactive_plan|\b_fault_throttle)\s*\("
@@ -151,6 +158,7 @@ def _fault_call_in(line: str) -> bool:
 FAULT_ALLOWED = (
     os.path.join("obs", "faults.py"),
     os.path.join("parallel", "collectives.py"),
+    os.path.join("serve", "resilience.py"),
 )
 
 
@@ -227,6 +235,10 @@ def test_fault_lint_sees_the_wrapper_modules():
             if _FAULT_CALL.search(fh.read()):
                 hits.append(rel)
     assert os.path.join("parallel", "collectives.py") in hits, hits
+    # Round 15: the serve-scoped application point
+    # (resilience.apply_serve_faults) must live where the allowlist
+    # says it does.
+    assert os.path.join("serve", "resilience.py") in hits, hits
 
 
 def test_pallas_lint_sees_the_kernel_modules():

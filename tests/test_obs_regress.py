@@ -39,10 +39,10 @@ def test_headline_from_compact_line_era():
     head = R.headline_from_artifact({
         "parsed": {"metric": "m", "value": 1.0,
                    "headline": {"flagship_large_step_ms": 360.33,
-                                "ring_achieved_gbps": 123.4}},
+                                "ring_gbps_xla": 123.4}},
     })
     assert head == {"flagship_large_step_ms": 360.33,
-                    "ring_achieved_gbps": 123.4}
+                    "ring_gbps_xla": 123.4}
 
 
 def test_headline_from_parsed_null_recovers_from_tail():
@@ -185,8 +185,10 @@ def test_compare_missing_keys_skip_never_fail():
     rows = _rows_by_key(R.compare({}, [("r1", {})]))
     assert all(r["verdict"] == "SKIP" for r in rows.values())
     # New key with no prior: SKIP (headline keys accrete by design).
-    rows = _rows_by_key(R.compare({"ring_achieved_gbps": 100.0}, []))
-    assert rows["ring_achieved_gbps"]["verdict"] == "SKIP"
+    # (re-keyed to ring_gbps_xla when round 15 retired the
+    # ring_achieved_gbps tolerance with its compact-line slot)
+    rows = _rows_by_key(R.compare({"ring_gbps_xla": 100.0}, []))
+    assert rows["ring_gbps_xla"]["verdict"] == "SKIP"
 
 
 def test_print_gate_rc_and_table():

@@ -264,6 +264,83 @@ def test_page_pool_churn_no_leak_no_double_alloc():
     assert pp.available(0) == pp.capacity
 
 
+def test_page_pool_free_is_atomic_on_bad_input():
+    # The round-15 bugfix: free() must validate the WHOLE sequence
+    # before touching the pool. Round 13's loop freed page-by-page,
+    # so free([good, bad]) freed `good`, raised, and a retry of the
+    # same list (exactly what a preemption error path would do)
+    # double-freed it.
+    pp = PagePool(16, 8)
+    got = pp.alloc_n(4)
+    avail = pp.available(0)
+    with pytest.raises(ValueError):
+        pp.free([got[0], 999], 0)       # bad tail: nothing freed
+    assert pp.available(0) == avail
+    pp.free([got[0]], 0)                # good retry works exactly once
+    with pytest.raises(ValueError):
+        pp.free([got[1], got[1]], 0)    # intra-call duplicate
+    assert pp.available(0) == avail + 1
+    pp.free(got[1:], 0)
+    assert pp.available(0) == pp.capacity
+
+
+def test_page_pool_churn_interleaved_preempt_free_realloc():
+    # The round-15 churn pin: alloc/free invariants were exercised
+    # only on the run-to-completion path before preemption existed.
+    # This drives the preempt-shaped interleaving — grow a "slot" one
+    # page at a time, preempt (free the WHOLE page list mid-growth),
+    # immediately realloc for another slot — and checks after every
+    # event that no page is double-held and the free set is exact.
+    rng = np.random.default_rng(2)
+    pp = PagePool(24, 8, n_shards=2)
+    for shard in range(2):
+        held = {}          # slot -> pages (in alloc order)
+        outstanding = set()
+        next_slot = 0
+        for _ in range(600):
+            op = rng.random()
+            if op < 0.4 or not held:        # admit/grow
+                slot = (next_slot if op < 0.2 or not held
+                        else int(rng.choice(list(held))))
+                if slot == next_slot:
+                    held[slot] = []
+                    next_slot += 1
+                if pp.available(shard):
+                    pid = pp.alloc(shard)
+                    assert pid not in outstanding, "double alloc"
+                    assert pid != TRASH_PAGE
+                    outstanding.add(pid)
+                    held[slot].append(pid)
+            elif op < 0.8:                  # preempt: free whole slot
+                slot = int(rng.choice(list(held)))
+                pages = held.pop(slot)
+                pp.free(pages, shard)
+                outstanding -= set(pages)
+                # Preempt/realloc race: the freed pages must be
+                # immediately reallocatable (the victim's pages feed
+                # the growing slot the same scheduling round).
+                if pages:
+                    pid = pp.alloc(shard)
+                    assert pid not in outstanding
+                    outstanding.add(pid)
+                    held.setdefault(next_slot, []).append(pid)
+                    next_slot += 1
+            else:                           # finish: free + retire
+                slot = int(rng.choice(list(held)))
+                pages = held.pop(slot)
+                pp.free(pages, shard)
+                outstanding -= set(pages)
+            assert pp.available(shard) == pp.capacity - len(outstanding)
+        for pages in held.values():
+            pp.free(pages, shard)
+        # Exact free-list restoration: full again, and the free SET is
+        # precisely every non-trash page (nothing lost, nothing
+        # duplicated).
+        assert pp.available(shard) == pp.capacity
+        assert sorted(pp._free[shard]) == list(
+            range(1, pp.pages_per_shard))
+
+
 def test_page_pool_validation():
     with pytest.raises(ValueError, match="page_len"):
         PagePool(8, 12)

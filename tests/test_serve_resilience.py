@@ -1,0 +1,480 @@
+"""Serving resilience: lazy page growth, preemption, admission
+control, deadline shedding, seeded EOS stop, serve faults, chaos.
+
+The load-bearing pins: (1) admission reserves only the prefill's
+pages and decode grows on demand — with preemption-on-exhaustion
+losing ZERO completed tokens (generated ids ride re-admission as
+prompt extension); (2) every resilience decision is length-driven, so
+the dry schedule simulator and the device batcher agree event for
+event (the round-13 replay-exactness contract extended to preempt/
+shed/stop verdicts); (3) the victim policy, the shed verdicts' obs
+records, the `obs watch` shed alerts, and the serve-scoped fault
+plumbing are each pinned in isolation. The three-scenario chaos smoke
+end-to-end lives in the cli_serve_chaos_8dev.txt golden (exit 0 =
+all graded) plus the @slow twin here.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from tpu_p2p.config import ServeConfig
+from tpu_p2p.obs import faults
+from tpu_p2p.serve import resilience as R
+from tpu_p2p.serve.batcher import Batcher, Request, simulate_schedule
+from tpu_p2p.serve.engine import run_engine, serve_mesh
+from tpu_p2p.serve.paged_cache import PagePool
+
+
+def _req(rid, n_prompt=8, max_new=4, arrival=0):
+    return Request(rid=rid, prompt=np.zeros(n_prompt, np.int32),
+                   max_new=max_new, arrival_step=arrival)
+
+
+def _dry(**kw):
+    base = dict(slots=2, page_len=8, num_pages=8, max_blocks=3,
+                chunk=4, dry=True)
+    base.update(kw)
+    return Batcher(None, None, None, **base)
+
+
+# ------------------------------------------------- lazy page growth
+
+
+def test_admission_reserves_prefill_pages_only():
+    # 9-token prompt + 8 new = 3 blocks worst case, but admission
+    # must take only the prompt's 2 — the tentpole claim (capacity is
+    # the actual footprint, not the worst case).
+    b = _dry()
+    b.submit(_req(0, n_prompt=9, max_new=8))
+    b._admit()
+    s = b.slots[0]
+    assert s is not None
+    assert len(s.pages) == 2
+    assert b.pool_alloc.available(0) == b.pool_alloc.capacity - 2
+
+
+def test_decode_growth_allocates_on_demand_and_drains_clean():
+    b = _dry()
+    r = _req(0, n_prompt=8, max_new=9)  # grows into blocks 2 and 3
+    done = b.run([r])
+    assert len(done) == 1
+    assert len(done[0].generated) == 9
+    assert done[0].preemptions == 0
+    # Leak check after lazy growth: the pool is exactly full again.
+    assert b.pool_alloc.available(0) == b.pool_alloc.capacity
+
+
+def test_preemption_dry_zero_token_loss_and_deterministic():
+    # 2 slots on one shard, pool clamped so two concurrent requests
+    # cannot both hold their full footprint: growth must preempt, and
+    # every request must STILL deliver its full length.
+    trace = [_req(i, n_prompt=10, max_new=8) for i in range(4)]
+    kw = dict(slots=2, page_len=8, num_pages=8, max_blocks=3, chunk=4,
+              pool_clamp=4)
+    a = simulate_schedule(trace, **kw)
+    assert a["preemptions"] > 0
+    assert not a["shed"]
+    for r in a["requests"]:
+        assert len(r.generated) == r.max_new, r.rid
+    preempted = [r for r in a["requests"] if r.preemptions]
+    assert preempted
+    for r in preempted:
+        # Every preemption episode closed: recover spans recorded.
+        assert r.preempt_recover_steps
+        assert all(s > 0 for s in r.preempt_recover_steps)
+    b = simulate_schedule(trace, **kw)
+    assert a["steps"] == b["steps"]
+    assert a["preempt_events"] == b["preempt_events"]
+
+
+def test_preempted_pages_free_exactly_and_pool_drains():
+    trace = [_req(i, n_prompt=10, max_new=8) for i in range(4)]
+    sim_b = _dry(pool_clamp=4)
+    sim_b.run(trace)
+    assert sim_b.preempt_events
+    # The clamped pool is exactly full again (clamped capacity).
+    assert sim_b.pool_alloc.capacity == 4
+    assert sim_b.pool_alloc.available(0) == 4
+
+
+def test_victim_policy_least_generated_ties_to_younger():
+    from tpu_p2p.serve.batcher import _Slot
+
+    r0 = _req(0)
+    r0.generated = [1, 2, 3]
+    r1 = _req(1)
+    r1.generated = [1]
+    r2 = _req(2)
+    r2.generated = [1]
+    slots = [_Slot(r0, [1], 8), _Slot(r1, [2], 8), _Slot(r2, [3], 8),
+             None]
+    shard_of = lambda i: 0  # noqa: E731
+    # Least generated wins; tie (r1 vs r2, one token each) goes to
+    # the LARGER rid (the younger request yields).
+    assert R.choose_victim(slots, 0, shard_of) == 2
+    # Empty shard: None (the growth loop treats it as a real bug).
+    assert R.choose_victim([None, None], 0, shard_of) is None
+
+
+def test_sim_matches_real_batcher_under_preemption():
+    # The replay-exactness contract under the NEW machinery: the dry
+    # simulator and a real device batcher must agree on step count,
+    # preempt events, and every request's step lifecycle.
+    import jax  # noqa: F401 — device run below
+
+    from tpu_p2p.models import flagship as F
+
+    cfg = F.FlagshipConfig(batch=2, seq=16, heads=4, head_dim=8,
+                           stages=2, microbatches=1, dense_ffn=True,
+                           moe_mult=2, vocab=64, norm=True, rope=True)
+    mesh = serve_mesh(1)
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    rng = np.random.default_rng(7)
+    trace = [Request(rid=i,
+                     prompt=rng.integers(0, 64, 10).astype(np.int32),
+                     max_new=8, arrival_step=0) for i in range(4)]
+    kw = dict(slots=2, page_len=8, num_pages=8, max_blocks=3, chunk=4,
+              pool_clamp=4)
+    sim = simulate_schedule(trace, **kw)
+    b = Batcher(mesh, cfg, params, mode="continuous", **kw)
+    done = b.run([r.fresh() for r in trace])
+    assert sim["preemptions"] > 0  # the scenario actually preempts
+    assert b.step_idx == sim["steps"] + sim["idle_steps"]
+    assert b.preempt_events == sim["preempt_events"]
+    by_rid = {r.rid: r for r in sim["requests"]}
+    for r in done:
+        s = by_rid[r.rid]
+        assert (r.prefill_start_step, r.first_token_step,
+                r.finish_step, r.preempt_steps) == \
+            (s.prefill_start_step, s.first_token_step,
+             s.finish_step, s.preempt_steps), r.rid
+        assert len(r.generated) == r.max_new
+
+
+# ------------------------------------------- admission + deadlines
+
+
+def test_bounded_queue_sheds_on_admission():
+    b = _dry(slots=1, queue_depth=2)
+    b.submit(_req(0))  # admitted next step; until then it queues
+    b.submit(_req(1))
+    ok = b.submit(_req(2))
+    assert ok is False
+    assert len(b.shed) == 1
+    shed = b.shed[0]
+    assert shed.rid == 2
+    assert shed.outcome == R.OUTCOME_SHED_ADMISSION
+    assert shed.shed_step == 0
+    # The survivors complete untouched.
+    done = b.run([])
+    assert sorted(r.rid for r in done) == [0, 1]
+    for r in done:
+        assert r.outcome == R.OUTCOME_COMPLETED
+
+
+def test_deadline_sheds_unserved_queued_requests():
+    # 1 slot, request 0 occupies it for many steps; request 1's
+    # deadline expires in the queue → shed_deadline with the verdict
+    # step recorded.
+    b = _dry(slots=1, deadline_steps=3)
+    long = _req(0, n_prompt=8, max_new=12)
+    late = _req(1, n_prompt=8, max_new=4, arrival=0)
+    done = b.run([long, late])
+    assert [r.rid for r in done] == [0]
+    assert len(b.shed) == 1
+    assert b.shed[0].rid == 1
+    assert b.shed[0].outcome == R.OUTCOME_SHED_DEADLINE
+    assert b.shed[0].deadline_step == 3
+    assert b.shed[0].shed_step > 3
+
+
+def test_preempted_requests_exempt_from_deadline_shed():
+    # Preemption re-enqueues mid-service; the deadline pass must not
+    # shed them (that would lose completed tokens). Tight deadline +
+    # forced preemption: everything still completes.
+    trace = [_req(i, n_prompt=10, max_new=8, arrival=0)
+             for i in range(2)]
+    sim = simulate_schedule(trace, slots=2, page_len=8, num_pages=8,
+                            max_blocks=3, chunk=4, pool_clamp=4,
+                            deadline_steps=2)
+    assert sim["preemptions"] > 0
+    assert not sim["shed"]
+    for r in sim["requests"]:
+        assert len(r.generated) == r.max_new
+
+
+# ------------------------------------------------------- EOS stop
+
+
+def test_eos_stop_seeded_deterministic_value_free():
+    draws = [R.eos_stop(0, 3, k, 0.3) for k in range(1, 40)]
+    assert draws == [R.eos_stop(0, 3, k, 0.3) for k in range(1, 40)]
+    assert any(draws) and not all(draws)
+    # Different seed / rid → different sequence (no accidental
+    # correlation across requests).
+    assert draws != [R.eos_stop(1, 3, k, 0.3) for k in range(1, 40)]
+    assert draws != [R.eos_stop(0, 4, k, 0.3) for k in range(1, 40)]
+
+
+def test_eos_stop_varies_lengths_and_replays_exactly():
+    trace = [_req(i, n_prompt=8, max_new=12) for i in range(6)]
+    kw = dict(slots=2, page_len=8, num_pages=20, max_blocks=3,
+              chunk=4, stop="eos", stop_seed=5, eos_prob=0.35)
+    a = simulate_schedule(trace, **kw)
+    lens = sorted(len(r.generated) for r in a["requests"])
+    assert len(set(lens)) > 1          # genuinely variable-length
+    assert all(1 <= n <= 12 for n in lens)  # max_new still caps
+    b = simulate_schedule(trace, **kw)
+    assert [len(r.generated) for r in sorted(a["requests"],
+                                             key=lambda r: r.rid)] \
+        == [len(r.generated) for r in sorted(b["requests"],
+                                             key=lambda r: r.rid)]
+    # Length-driven default is untouched: stop="length" yields exact
+    # max_new lengths on the same trace.
+    c = simulate_schedule(trace, slots=2, page_len=8, num_pages=20,
+                          max_blocks=3, chunk=4)
+    assert all(len(r.generated) == 12 for r in c["requests"])
+
+
+def test_batcher_and_config_validate_resilience_knobs():
+    with pytest.raises(ValueError, match="stop"):
+        _dry(stop="tokens")
+    with pytest.raises(ValueError, match="eos_prob"):
+        _dry(stop="eos", eos_prob=0.0)
+    with pytest.raises(ValueError, match=">= 0"):
+        _dry(queue_depth=-1)
+    with pytest.raises(ValueError, match="stop"):
+        ServeConfig(stop="tokens")
+    with pytest.raises(ValueError, match="eos_prob"):
+        ServeConfig(stop="eos", eos_prob=1.5)
+    with pytest.raises(ValueError, match=">= 0"):
+        ServeConfig(deadline_steps=-1)
+
+
+# -------------------------------------------------- engine records
+
+
+def _sc(**kw):
+    base = dict(slots=4, page_len=8, num_pages=24, max_blocks=3,
+                chunk=4, requests=6, seed=0, rate=1.0,
+                prompt_len=(4, 12), gen_len=(4, 8), vocab=64)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def test_engine_emits_outcome_fields_and_shed_records():
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.serve.engine import synthetic_trace
+
+    mesh = serve_mesh(1)
+    sc = _sc(requests=6, rate=20.0, queue_depth=2, slots=1,
+             num_pages=6)
+    cfg = F.FlagshipConfig(batch=1, seq=16, heads=4, head_dim=8,
+                           stages=2, microbatches=1, dense_ffn=True,
+                           moe_mult=2, vocab=64, norm=True, rope=True)
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    recs = []
+    s = run_engine(mesh, cfg, params, synthetic_trace(sc), sc=sc,
+                   mode="continuous", emit=recs.append)
+    assert s["shed"] > 0
+    assert s["requests"] + s["shed"] == 6
+    assert s["shed_frac"] == pytest.approx(s["shed"] / 6, abs=1e-3)
+    reqs = [r for r in recs if r["obs"] == "request"]
+    assert len(reqs) == 6
+    outcomes = {r["id"]: r["outcome"] for r in reqs}
+    assert set(outcomes.values()) >= {R.OUTCOME_COMPLETED,
+                                      R.OUTCOME_SHED_ADMISSION}
+    for r in reqs:
+        if r["outcome"].startswith("shed"):
+            assert r["shed_step"] is not None
+            assert r["finish_step"] is None
+        else:
+            assert r["preemptions"] == 0
+        json.dumps(r)  # the --obs-jsonl contract
+    summ = [r for r in recs if r["obs"] == "serve_summary"]
+    assert len(summ) == 1
+    assert summ[0]["shed"] == s["shed"]
+    json.dumps(summ[0])
+
+
+# --------------------------------------------------------- watch
+
+
+def _watch(tmp_path, rows, argv=()):
+    import io
+
+    from tpu_p2p.obs.health import watch_main
+
+    path = tmp_path / "obs.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    buf = io.StringIO()
+    rc = watch_main([str(path), *argv], stream=buf)
+    return rc, buf.getvalue()
+
+
+def _req_row(i, outcome, shed_step=None):
+    return {"obs": "request", "id": i, "outcome": outcome,
+            "shed_step": shed_step}
+
+
+def test_watch_alerts_on_shed_verdicts(tmp_path):
+    rows = [_req_row(0, "completed"),
+            _req_row(1, "shed_admission", 4),
+            _req_row(2, "shed_deadline", 9)]
+    rc, out = _watch(tmp_path, rows)
+    assert rc == 1
+    assert "ALERT" in out and "shed_admission" in out
+    assert "shed_deadline" in out
+    assert "3 request row(s), 2 shed" in out
+    # --expect-alerts inversion (the chaos CI contract).
+    rc, _ = _watch(tmp_path, rows, ["--expect-alerts"])
+    assert rc == 0
+
+
+def test_watch_shed_rate_threshold(tmp_path):
+    # 1 shed in 10 requests = 0.1 frac: tolerated at 0.25, alerted at
+    # the default 0 — rate-based alerting, not per-event.
+    rows = [_req_row(i, "completed") for i in range(9)]
+    rows.insert(5, _req_row(9, "shed_admission", 3))
+    rc, out = _watch(tmp_path, rows, ["--max-shed-frac", "0.25"])
+    assert rc == 0
+    assert "ALERT" not in out
+    assert "10 request row(s), 1 shed" in out
+    rc, out = _watch(tmp_path, rows)
+    assert rc == 1 and "ALERT" in out
+
+
+def test_watch_without_request_rows_keeps_round12_output(tmp_path):
+    # Training-only logs must not grow the new summary line (the
+    # cli_obs_watch_8dev.txt golden byte contract).
+    rows = [{"obs": "step", "step": i, "step_ms": 10.0}
+            for i in range(5)]
+    rc, out = _watch(tmp_path, rows)
+    assert rc == 0
+    assert "request row" not in out
+
+
+# ------------------------------------------------- fault plumbing
+
+
+def test_fault_plan_serve_fields_validate_and_describe():
+    plan = faults.FaultPlan(page_pool_clamp=4)
+    assert "clamp page pool to 4/shard" in plan.describe()
+    plan = faults.FaultPlan(storm_step=6, storm_requests=32)
+    assert "storm 32 requests at step 6" in plan.describe()
+    with pytest.raises(ValueError, match="page_pool_clamp"):
+        faults.FaultPlan(page_pool_clamp=0)
+    with pytest.raises(ValueError, match="together"):
+        faults.FaultPlan(storm_step=4)
+    with pytest.raises(ValueError, match="together"):
+        faults.FaultPlan(storm_requests=8)
+    with pytest.raises(ValueError, match="storm_step"):
+        faults.FaultPlan(storm_step=-1, storm_requests=8)
+
+
+def test_apply_serve_faults_is_the_single_application_point():
+    sc = _sc()
+    trace = [_req(0)]
+    # No plan: identity, zero overhead.
+    out, clamp, hook = R.apply_serve_faults(trace, sc)
+    assert out is trace and clamp is None and hook is None
+    # Storm: burst appended with continuing rids at the storm step.
+    with faults.injecting(faults.FaultPlan(storm_step=5,
+                                           storm_requests=6)):
+        out, clamp, hook = R.apply_serve_faults(trace, sc)
+    assert len(out) == 7 and clamp is None and hook is None
+    burst = out[1:]
+    assert [r.rid for r in burst] == [1, 2, 3, 4, 5, 6]
+    assert all(r.arrival_step == 5 for r in burst)
+    assert all(sc.prompt_len[0] <= r.n_prompt <= sc.prompt_len[1]
+               for r in burst)
+    # Deterministic burst (seeded off the trace seed).
+    with faults.injecting(faults.FaultPlan(storm_step=5,
+                                           storm_requests=6)):
+        out2, _, _ = R.apply_serve_faults(trace, sc)
+    for a, b in zip(out[1:], out2[1:]):
+        np.testing.assert_array_equal(a.prompt, b.prompt)
+        assert a.max_new == b.max_new
+    # Clamp + slow hook plumb through (the hook closes over the
+    # plan, so it keeps applying outside the injecting block — the
+    # batcher holds it for the run's whole extent).
+    with faults.injecting(faults.FaultPlan(page_pool_clamp=3,
+                                           slow_rank=0, slow_ms=5.0,
+                                           start_step=2)):
+        _, clamp, hook = R.apply_serve_faults(trace, sc)
+    assert clamp == 3
+    assert callable(hook)
+    hook(3)  # applies the (tiny) delay without raising
+    # The gating itself (start_step, slow_ms) is maybe_slow_host's —
+    # pin it via the injectable sleep.
+    slept = []
+    plan = faults.FaultPlan(slow_rank=0, slow_ms=5.0, start_step=2)
+    assert faults.maybe_slow_host(plan, 1, sleep=slept.append) is False
+    assert faults.maybe_slow_host(plan, 3, sleep=slept.append) is True
+    assert slept == [0.005]
+
+
+def test_pool_clamp_capacity_semantics():
+    pp = PagePool(16, 8, n_shards=2)
+    pp.clamp_capacity(3)
+    assert pp.capacity == 3
+    assert pp.available(0) == 3 and pp.available(1) == 3
+    got = [pp.alloc(0) for _ in range(3)]
+    from tpu_p2p.serve.paged_cache import OutOfPages, TRASH_PAGE
+
+    assert TRASH_PAGE not in got
+    with pytest.raises(OutOfPages):
+        pp.alloc(0)
+    pp.free(got, 0)
+    assert pp.available(0) == 3
+    # Clamp validates and refuses a live pool.
+    with pytest.raises(ValueError, match="usable"):
+        PagePool(16, 8).clamp_capacity(0)
+    live = PagePool(16, 8)
+    live.alloc(0)
+    with pytest.raises(RuntimeError, match="construction"):
+        live.clamp_capacity(3)
+
+
+# ----------------------------------------------------- chaos smoke
+
+
+@pytest.mark.slow  # tier-1 budget (~20 s: three full engine traces +
+# dense parity rollouts). Tier-1 keeps the end-to-end path through
+# the cli_serve_chaos_8dev.txt golden (exit 0 = all graded).
+def test_run_chaos_grades_all_three_scenarios():
+    import io
+
+    log = io.StringIO()
+    res = R.run_chaos(out=log)
+    assert res["ok"], log.getvalue()
+    assert res["preempt_clamp"]["preemptions"] > 0
+    assert res["preempt_clamp"]["token_loss"] == 0
+    assert res["preempt_clamp"]["parity_ok"]
+    assert res["storm_shed"]["shed"] > 0
+    assert res["storm_shed"]["detect_lag_steps"] <= 6
+    assert res["slow_step"]["tokens_bitwise"]
+    assert res["serve_preempt_recover_steps"] > 0
+    assert 0 < res["serve_shed_frac_overload"] < 1
+
+
+def test_fresh_request_resets_resilience_state():
+    r = _req(3)
+    r.generated = [1, 2]
+    r.preemptions = 2
+    r.preempt_steps = [4, 9]
+    r.outcome = "completed"
+    r.shed_step = 7
+    r.pending_preempt_step = 9
+    f = r.fresh()
+    assert f.rid == 3 and f.max_new == r.max_new
+    assert f.generated == [] and f.preemptions == 0
+    assert f.preempt_steps == [] and f.preempt_recover_steps == []
+    assert f.outcome is None and f.shed_step is None
+    assert f.pending_preempt_step is None
+    # dataclasses.replace stays usable for pre-round-15 idioms.
+    g = dataclasses.replace(r, generated=[])
+    assert g.generated == []

@@ -282,6 +282,14 @@ BATCHING = ("continuous", "static", "both")
 # run-to-completion baseline (the batch refills only when every slot
 # drained — the A/B bench grades); both = run the A/B on one trace.
 
+SERVE_STOPS = ("length", "eos")
+# Serving stop rules (docs/serving_resilience.md): length = generate
+# exactly max_new tokens (the default — schedules stay trivially
+# length-driven); eos = seeded variable-length stopping, each
+# generated token drawing a stop decision keyed on (seed, request_id,
+# generation index) — value-free, so the dry schedule simulator and
+# the device batcher agree bit for bit and replay stays exact.
+
 
 @dataclass(frozen=True)
 class ServeConfig:
@@ -308,6 +316,17 @@ class ServeConfig:
     gen_len: Tuple[int, int] = (4, 8)       # inclusive
     vocab: int = 128
     dtype: str = "float32"
+    # Resilience knobs (round 15, docs/serving_resilience.md) — all
+    # default-off, preserving the round-13 behavior:
+    queue_depth: int = 0      # bounded admission queue (0 =
+    # unbounded); a submit against a full queue sheds immediately
+    # with outcome "shed_admission"
+    deadline_steps: int = 0   # admission deadline in scheduler steps
+    # (0 = none): a queued request whose prefill has not started
+    # within this many steps of enqueue sheds with "shed_deadline"
+    stop: str = "length"      # stop rule, one of SERVE_STOPS
+    eos_prob: float = 0.1     # stop="eos": per-token seeded stop
+    # probability (geometric lengths capped by max_new)
 
     def __post_init__(self) -> None:
         if self.page_len <= 0 or self.page_len % 8:
@@ -330,6 +349,21 @@ class ServeConfig:
                 raise ValueError(f"{name} must be positive")
         if self.rate <= 0:
             raise ValueError(f"rate must be positive, got {self.rate}")
+        if self.stop not in SERVE_STOPS:
+            raise ValueError(
+                f"unknown stop {self.stop!r}; expected one of "
+                f"{SERVE_STOPS}"
+            )
+        if self.stop == "eos" and not 0.0 < self.eos_prob < 1.0:
+            raise ValueError(
+                f"stop='eos' needs eos_prob in (0, 1), got "
+                f"{self.eos_prob}"
+            )
+        if self.queue_depth < 0 or self.deadline_steps < 0:
+            raise ValueError(
+                "queue_depth and deadline_steps must be >= 0 "
+                "(0 disables)"
+            )
         for name in ("prompt_len", "gen_len"):
             lo, hi = getattr(self, name)
             if lo < 1 or hi < lo:

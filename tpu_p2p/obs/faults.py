@@ -40,11 +40,30 @@ The three fault shapes, and where each is applied:
   into a ``lost_host`` verdict (then ``train.py --heal`` reshards
   onto the survivors; docs/health.md).
 
-Fault-injection wrappers live ONLY here and in
-``parallel/collectives.py`` — enforced by the grep-lint in
-tests/test_no_raw_collectives.py, the same way raw collectives are
-confined: a throttle call in model code would distort transport the
-ledger (and the detectors) could never attribute.
+Round 15 added the SERVE-scoped fault shapes the chaos smoke
+(``python -m tpu_p2p serve --chaos``, docs/serving_resilience.md)
+injects, applied exclusively by ``serve/resilience.py``:
+
+- **Page-pool clamp** (``page_pool_clamp``): each shard's usable KV
+  pages clamped to this count at batcher construction
+  (``PagePool.clamp_capacity``) — the deterministic stand-in for
+  HBM pressure, forcing the lazy-growth path into preemption.
+- **Request storm** (``storm_step`` + ``storm_requests``): a burst of
+  synthetic requests all arriving at one scheduler step — the
+  overload that admission control and deadline shedding must turn
+  into shed verdicts instead of unbounded queueing.
+- **Slow step**: the existing ``slow_rank`` / ``slow_ms`` straggler
+  rides the serve host loop through the batcher's per-step hook
+  (:func:`maybe_slow_host`, same entry point as training) — serving
+  schedules are step-indexed, so the graded claim is that a slow host
+  changes latency telemetry and NOTHING else.
+
+Fault-injection wrappers live ONLY here, in
+``parallel/collectives.py``, and in ``serve/resilience.py`` —
+enforced by the grep-lint in tests/test_no_raw_collectives.py, the
+same way raw collectives are confined: a throttle call in model code
+would distort transport the ledger (and the detectors) could never
+attribute.
 """
 
 from __future__ import annotations
@@ -76,6 +95,10 @@ class FaultPlan:
     slow_rank: Optional[int] = None
     slow_ms: float = 0.0  # injected per-step host delay
     lost_host: Optional[int] = None
+    # Serve-scoped shapes (round 15; applied by serve/resilience.py):
+    page_pool_clamp: Optional[int] = None  # usable KV pages per shard
+    storm_step: Optional[int] = None  # burst arrival scheduler step
+    storm_requests: int = 0  # burst size (> 0 iff storm_step set)
     start_step: int = 0
 
     def __post_init__(self) -> None:
@@ -96,6 +119,22 @@ class FaultPlan:
                 f"slow_rank={self.slow_rank} needs slow_ms > 0, got "
                 f"{self.slow_ms}"
             )
+        if self.page_pool_clamp is not None and self.page_pool_clamp < 1:
+            raise ValueError(
+                f"page_pool_clamp must leave >= 1 usable page per "
+                f"shard, got {self.page_pool_clamp}"
+            )
+        if (self.storm_step is None) != (self.storm_requests <= 0):
+            raise ValueError(
+                f"storm_step={self.storm_step} and storm_requests="
+                f"{self.storm_requests} must be set together (a step "
+                "with no burst, or a burst with no step, is a no-op "
+                "plan that would grade as an undetected fault)"
+            )
+        if self.storm_step is not None and self.storm_step < 0:
+            raise ValueError(
+                f"storm_step must be >= 0, got {self.storm_step}"
+            )
         if self.start_step < 0:
             raise ValueError(f"start_step must be >= 0, got "
                              f"{self.start_step}")
@@ -110,6 +149,12 @@ class FaultPlan:
                          f"{self.slow_ms:g} ms/step")
         if self.lost_host is not None:
             parts.append(f"lose host {self.lost_host}")
+        if self.page_pool_clamp is not None:
+            parts.append(f"clamp page pool to {self.page_pool_clamp}"
+                         "/shard")
+        if self.storm_step is not None:
+            parts.append(f"storm {self.storm_requests} requests at "
+                         f"step {self.storm_step}")
         tail = f" from step {self.start_step}" if self.start_step else ""
         return ("; ".join(parts) or "no-op plan") + tail
 

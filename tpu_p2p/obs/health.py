@@ -622,12 +622,13 @@ def _build_watch_parser() -> argparse.ArgumentParser:
         prog="python -m tpu_p2p obs watch",
         description="Tail an --obs-jsonl step timeline and alert on "
                     "health verdicts: embedded {'obs': 'health'} "
-                    "records are re-printed, and stragglers are "
+                    "records are re-printed, stragglers are "
                     "re-scored from the step rows (median/MAD), so "
-                    "un-monitored logs alert too. Exit codes "
-                    "(docs/health.md): 0 = no alerts, 1 = alerts "
-                    "(inverted by --expect-alerts), 2 = unreadable "
-                    "input.",
+                    "un-monitored logs alert too, and serve "
+                    "{'obs': 'request'} shed verdicts alert past "
+                    "--max-shed-frac. Exit codes (docs/health.md): "
+                    "0 = no alerts, 1 = alerts (inverted by "
+                    "--expect-alerts), 2 = unreadable input.",
     )
     p.add_argument("path", help="obs JSONL file (train.py --obs-jsonl)")
     p.add_argument("--follow", action="store_true",
@@ -646,6 +647,13 @@ def _build_watch_parser() -> argparse.ArgumentParser:
                    default=HealthConfig.straggler_z)
     p.add_argument("--straggler-window", type=int,
                    default=HealthConfig.straggler_window)
+    p.add_argument("--max-shed-frac", type=float, default=0.0,
+                   metavar="F",
+                   help="alert on a serve {'obs': 'request'} shed "
+                        "verdict once the cumulative shed fraction "
+                        "exceeds F (default 0: any shed alerts — a "
+                        "healthy trace sheds nothing; "
+                        "docs/serving_resilience.md)")
     return p
 
 
@@ -662,10 +670,12 @@ def watch_main(argv: Optional[Sequence[str]] = None,
                             z=args.straggler_z)
     alerts = 0
     steps = 0
+    requests = 0
+    shed = 0
 
     def handle(line: str) -> bool:
         """→ True when this row alerted."""
-        nonlocal alerts, steps
+        nonlocal alerts, steps, requests, shed
         line = line.strip()
         if not line:
             return False
@@ -674,7 +684,26 @@ def watch_main(argv: Optional[Sequence[str]] = None,
         except json.JSONDecodeError:
             return False  # torn tail of a live file
         hit = False
-        if rec.get("obs") == "health":
+        if rec.get("obs") == "request":
+            # Serve span records (docs/serving_resilience.md): a shed
+            # verdict alerts once the cumulative shed fraction clears
+            # the threshold — rate-based, so one deliberate shed in a
+            # million-request log can be tolerated via --max-shed-frac
+            # while the default (0) treats any shed as an incident.
+            requests += 1
+            outcome = rec.get("outcome") or ""
+            if outcome.startswith("shed"):
+                shed += 1
+                if shed / requests > args.max_shed_frac:
+                    v = HealthVerdict(
+                        kind=outcome, step=int(rec.get("shed_step")
+                                               or 0),
+                        detail={"id": rec.get("id"),
+                                "shed_frac": round(shed / requests,
+                                                   4)})
+                    out.write(f"# ALERT {v.describe()}\n")
+                    hit = True
+        elif rec.get("obs") == "health":
             v = HealthVerdict(kind=rec.get("verdict", "?"),
                               step=int(rec.get("step", 0)),
                               detail={k: v for k, v in rec.items()
@@ -712,6 +741,11 @@ def watch_main(argv: Optional[Sequence[str]] = None,
                     idle = 0.0
                     if handle(line):
                         break
+    if requests:
+        # Printed only when serve spans were present, so training-log
+        # watches (and their golden) keep the round-12 byte contract.
+        out.write(f"# watch: {requests} request row(s), {shed} shed "
+                  f"(frac {shed / requests:.4f})\n")
     out.write(f"# watch: {alerts} alert(s) over {steps} step row(s)\n")
     out.flush()
     if args.expect_alerts:

@@ -94,19 +94,22 @@ TOLERANCES: Dict[str, Tolerance] = {
     # PR 5 pp-wave keys (bench.py _pp_overlap_metrics).
     "pp_overlap_frac": Tolerance("higher", 0.25),
     "pp_step_ms_overlap_wave": Tolerance("lower", 0.25),
-    # PR 9 schedule-IR keys (bench.py _pp_sched_metrics). The bubble
-    # fractions are ANALYTIC — pure properties of the compiled tick
-    # programs at the fixed canonical shape, identical round over
-    # round unless the schedule itself changes — so their tolerance
+    # PR 9 schedule-IR keys (bench.py _pp_sched_metrics). The zb
+    # bubble fraction is ANALYTIC — a pure property of the compiled
+    # tick program at the fixed canonical shape, identical round over
+    # round unless the schedule itself changes — so its tolerance
     # only exists to catch a schedule regression (a zb compiler edit
     # that re-opens the bubble). The measured step times ride the
     # same manual-executor machinery as the overlap step keys (25%).
-    "pp_bubble_frac_1f1b": Tolerance("lower", 0.25),
+    # Round 15 retired pp_bubble_frac_1f1b with its compact-line slot
+    # (an analytic CONSTANT of the fused schedule; zb < 1f1b is
+    # enforced inside the metric) and ring_achieved_gbps (the
+    # byte-equivalent twin of ring_gbps_xla below) — the serve
+    # resilience pair took their bytes (bench.py HEADLINE_KEYS note).
     "pp_bubble_frac_zb": Tolerance("lower", 0.25),
     "pp_step_ms_sched_1f1b": Tolerance("lower", 0.25),
     "pp_step_ms_sched_zb": Tolerance("lower", 0.25),
     # PR 3 obs keys (bench.py _obs_metrics).
-    "ring_achieved_gbps": Tolerance("higher", 0.25),
     "obs_step_ms_p50": Tolerance("lower", 0.30),
     # PR 6 dma-transport keys (bench.py _dma_transport_metrics): the
     # XLA-vs-Pallas p2p head-to-head. Latency floors are the
@@ -134,6 +137,20 @@ TOLERANCES: Dict[str, Tolerance] = {
     "serve_tokens_per_s": Tolerance("higher", 0.25),
     "serve_ttft_ms_p50": Tolerance("lower", 0.50),
     "serve_tok_ms_p99": Tolerance("lower", 0.50),
+    # PR 10 serving-resilience keys (bench.py
+    # _serve_resilience_metrics): both are SCHEDULE-deterministic
+    # (step-indexed, host-speed-independent — identical round over
+    # round unless the scheduler itself changes), so like the
+    # analytic bubble fraction their tolerances exist to catch a
+    # scheduler regression, not noise. detect_steps-style integer for
+    # the recovery span (100% = the fault may hold progress up twice
+    # as long before gating); the overload shed fraction gets an
+    # absolute floor — shedding UNDER overload is correct behavior,
+    # and any fraction at or below 0.6 passes outright (a lucky
+    # low-shed round must not min-ratchet an unpassable bar).
+    "serve_preempt_recover_steps": Tolerance("lower", 1.00),
+    "serve_shed_frac_overload": Tolerance("lower", 0.25,
+                                          abs_floor=0.6),
 }
 
 _TAIL_KV = re.compile(

@@ -14,6 +14,11 @@ Three layers (ROADMAP "production serving engine", docs/serving.md):
   (``python -m tpu_p2p serve``): synthetic Poisson traces, per-request
   spans into the ``--obs-jsonl`` timeline, and the aggregate
   tokens/s + TTFT/per-token latency summary bench grades.
+- :mod:`tpu_p2p.serve.resilience` — the robustness layer
+  (docs/serving_resilience.md): preemption victim policy behind the
+  batcher's lazy page growth, admission/deadline shed verdicts,
+  seeded EOS stopping, serve-scoped fault application, and the
+  ``serve --chaos`` smoke.
 """
 
 from tpu_p2p.serve.paged_cache import (  # noqa: F401
@@ -34,16 +39,30 @@ from tpu_p2p.serve.engine import (  # noqa: F401
     serve_mesh,
     synthetic_trace,
 )
+from tpu_p2p.serve.resilience import (  # noqa: F401
+    OUTCOME_COMPLETED,
+    OUTCOME_SHED_ADMISSION,
+    OUTCOME_SHED_DEADLINE,
+    choose_victim,
+    eos_stop,
+    run_chaos,
+)
 
 __all__ = [
     "Batcher",
+    "OUTCOME_COMPLETED",
+    "OUTCOME_SHED_ADMISSION",
+    "OUTCOME_SHED_DEADLINE",
     "OutOfPages",
     "PagePool",
     "Request",
     "TRASH_PAGE",
+    "choose_victim",
+    "eos_stop",
     "init_paged_pool",
     "make_paged_lm_step",
     "paged_pool_spec",
+    "run_chaos",
     "run_engine",
     "serve_mesh",
     "simulate_schedule",

@@ -12,13 +12,31 @@ only when EVERY slot has drained (the classic static-batching
 convention whose tail slots idle while the longest sequence
 finishes).
 
-Scheduling is length-driven only — greedy token VALUES never alter
-slot occupancy (no early-exit token in the synthetic traces) — which
-is what makes :func:`simulate_schedule` exact: the whole per-step
-input sequence (tokens/pos/n_active/tables) can be computed without
-touching a device, replayed later inside one scanned program for the
-bench's device-trace throughput slope, and compared across batching
-modes step-for-step (docs/serving.md).
+Round 15 (docs/serving_resilience.md) replaced worst-case
+admission-time page allocation with **lazy growth + preemption**:
+admission reserves only the pages the prefill needs, each slot grows
+its page table on demand as decode extends into new blocks, and when
+the shard's free list runs dry the scheduler preempts the victim with
+the least completed work (:func:`tpu_p2p.serve.resilience.
+choose_victim`), frees its pages, and re-enqueues it for
+recompute-from-prompt — the preempted request's generated tokens ride
+along as prompt extension, so no completed token is ever lost (the
+vLLM recompute convention, PAPERS.md). Admission is bounded
+(``queue_depth`` sheds on submit) and deadlined (``deadline_steps``
+sheds queued requests whose service never started in time); shed
+requests land in ``.shed`` with an ``outcome`` verdict the engine
+emits as ``{"obs": "request"}`` records.
+
+Scheduling stays length-driven: greedy token VALUES never alter slot
+occupancy, page movement, preemption, shedding, or stopping —
+``stop="eos"`` draws its per-token stop decision from a seeded hash of
+``(request_id, generation index)``, not from the token value — which
+is what keeps :func:`simulate_schedule` exact: the whole per-step
+input sequence (tokens/pos/n_active/tables) AND every
+preempt/shed/stop verdict can be computed without touching a device,
+replayed later inside one scanned program for the bench's
+device-trace throughput slope, and compared across batching modes
+step-for-step (docs/serving.md).
 """
 
 from __future__ import annotations
@@ -38,6 +56,13 @@ from tpu_p2p.serve.paged_cache import (
     init_paged_pool,
     make_paged_lm_step,
     pool_shards,
+)
+from tpu_p2p.serve.resilience import (
+    OUTCOME_COMPLETED,
+    OUTCOME_SHED_ADMISSION,
+    OUTCOME_SHED_DEADLINE,
+    choose_victim,
+    eos_stop,
 )
 
 BATCHING_MODES = ("continuous", "static")
@@ -65,6 +90,18 @@ class Request:
     t_first_token: Optional[float] = None
     t_finish: Optional[float] = None
     generated: List[int] = dataclasses.field(default_factory=list)
+    # Resilience lifecycle (docs/serving_resilience.md): the admission
+    # deadline in scheduler steps, the shed/complete verdict, and the
+    # preemption episode bookkeeping (each episode = first preempt →
+    # next emitted token; its length is the recover-steps metric).
+    deadline_step: Optional[int] = None
+    outcome: Optional[str] = None
+    shed_step: Optional[int] = None
+    preemptions: int = 0
+    preempt_steps: List[int] = dataclasses.field(default_factory=list)
+    preempt_recover_steps: List[int] = dataclasses.field(
+        default_factory=list)
+    pending_preempt_step: Optional[int] = None
 
     @property
     def n_prompt(self) -> int:
@@ -73,32 +110,88 @@ class Request:
     def blocks_needed(self, page_len: int) -> int:
         return -(-(self.n_prompt + self.max_new) // page_len)
 
+    def full_tokens(self) -> np.ndarray:
+        """Prompt + already-generated ids — the recompute-from-prompt
+        input stream a preempted request prefills from (in a dry
+        batcher the generated ids are 0-valued placeholders, which is
+        cost-identical: scheduling is length-driven)."""
+        if not self.generated:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int32)])
+
+    def fresh(self) -> "Request":
+        """A pristine copy for a new run: lifecycle, outputs, and
+        resilience state all reset (the ``dataclasses.replace(r,
+        generated=[])`` idiom predating round 15 misses the
+        preemption/shed fields)."""
+        return Request(rid=self.rid, prompt=self.prompt,
+                       max_new=self.max_new,
+                       arrival_step=self.arrival_step)
+
 
 class _Slot:
-    __slots__ = ("req", "pos", "phase", "pages")
+    __slots__ = ("req", "pos", "phase", "pages", "prefill_len")
 
-    def __init__(self, req: Request, pages: List[int]) -> None:
+    def __init__(self, req: Request, pages: List[int],
+                 prefill_len: int) -> None:
         self.req = req
         self.pos = 0            # tokens already resident in the cache
         self.phase = "prefill"
         self.pages = pages
+        # Prompt + generated-so-far at (re-)admission: where prefill
+        # hands over to decode. First admission: n_prompt; after a
+        # preemption the completed tokens re-enter as prompt extension.
+        self.prefill_len = prefill_len
 
 
 class Batcher:
     """Slot state + queue over the mixed step. ``dry=True`` builds no
     device program and records the schedule instead (tokens for
     not-yet-generated positions are 0 — cost-identical for replay,
-    value-irrelevant for scheduling)."""
+    value-irrelevant for scheduling).
+
+    Resilience knobs (all default-off → round-13 behavior except that
+    page allocation is now lazy): ``queue_depth`` bounds the queue
+    (overflow sheds at submit), ``deadline_steps`` sheds queued
+    requests whose prefill never started within the budget,
+    ``stop``/``stop_seed``/``eos_prob`` select seeded variable-length
+    stopping, ``pool_clamp`` clamps the usable pages per shard (the
+    injected-fault hook — resilience.py passes it, nothing else
+    should), and ``step_hook`` is called once per non-idle step with
+    the step index (the slow-step fault rides it).
+    """
 
     def __init__(self, mesh, cfg, params, *, slots: int, page_len: int,
                  num_pages: int, max_blocks: int, chunk: int,
                  mode: str = "continuous", dry: bool = False,
                  n_shards: Optional[int] = None,
+                 queue_depth: int = 0, deadline_steps: int = 0,
+                 stop: str = "length", stop_seed: int = 0,
+                 eos_prob: float = 0.0,
+                 pool_clamp: Optional[int] = None,
+                 step_hook: Optional[Callable[[int], None]] = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         if mode not in BATCHING_MODES:
             raise ValueError(
                 f"unknown batching mode {mode!r}; expected one of "
                 f"{BATCHING_MODES}"
+            )
+        from tpu_p2p.config import SERVE_STOPS
+
+        if stop not in SERVE_STOPS:
+            raise ValueError(
+                f"unknown stop rule {stop!r}; expected one of "
+                f"{SERVE_STOPS}"
+            )
+        if stop == "eos" and not 0.0 < eos_prob < 1.0:
+            raise ValueError(
+                f"stop='eos' needs eos_prob in (0, 1), got {eos_prob}"
+            )
+        if queue_depth < 0 or deadline_steps < 0:
+            raise ValueError(
+                "queue_depth and deadline_steps must be >= 0 "
+                "(0 disables)"
             )
         if n_shards is None:
             n_shards = pool_shards(mesh) if mesh is not None else 1
@@ -112,14 +205,23 @@ class Batcher:
         self.page_len, self.max_blocks = page_len, max_blocks
         self.chunk, self.mode, self.dry = chunk, mode, dry
         self.n_shards = n_shards
+        self.queue_depth = queue_depth
+        self.deadline_steps = deadline_steps
+        self.stop, self.stop_seed = stop, stop_seed
+        self.eos_prob = eos_prob
+        self.step_hook = step_hook
         self.clock = clock
         self.pool_alloc = PagePool(num_pages, page_len, n_shards)
+        if pool_clamp is not None:
+            self.pool_alloc.clamp_capacity(pool_clamp)
         self.queue: deque = deque()
         self.slots: List[Optional[_Slot]] = [None] * slots
         self.tables = np.zeros((slots, max_blocks), np.int32)
         self.step_idx = 0
         self.idle_steps = 0
         self.finished: List[Request] = []
+        self.shed: List[Request] = []
+        self.preempt_events: List[Dict] = []
         self.schedule: List[Dict[str, np.ndarray]] = [] if dry else None
         if not dry:
             self._step = make_paged_lm_step(
@@ -134,15 +236,50 @@ class Batcher:
     def _shard_of(self, slot: int) -> int:
         return slot // (self.slots_n // self.n_shards)
 
-    def submit(self, req: Request) -> None:
+    def _shed(self, req: Request, outcome: str) -> None:
+        req.outcome = outcome
+        req.shed_step = self.step_idx
+        self.shed.append(req)
+
+    def submit(self, req: Request) -> bool:
+        """Enqueue (→ True) or shed on admission (→ False): a full
+        bounded queue sheds the newcomer immediately — by the time the
+        queue is ``queue_depth`` deep, its wait already dominates any
+        deadline, and a cheap early verdict beats a late timeout
+        (docs/serving_resilience.md "when shedding beats queueing")."""
         req.enqueue_step = self.step_idx
         req.t_enqueue = self.clock()
+        if self.deadline_steps and req.deadline_step is None:
+            req.deadline_step = self.step_idx + self.deadline_steps
+        if self.queue_depth and len(self.queue) >= self.queue_depth:
+            self._shed(req, OUTCOME_SHED_ADMISSION)
+            return False
         self.queue.append(req)
+        return True
 
     def idle(self) -> bool:
         return not self.queue and all(s is None for s in self.slots)
 
+    def _shed_expired(self) -> None:
+        """Deadline pass over the QUEUE: a request whose service never
+        started (``prefill_start_step is None``) past its
+        ``deadline_step`` is shed. In-flight requests are exempt —
+        preemption re-enqueues them mid-service, and shedding one
+        would throw away completed tokens (the zero-loss contract)."""
+        if not self.deadline_steps:
+            return
+        kept: deque = deque()
+        for r in self.queue:
+            if (r.deadline_step is not None
+                    and r.prefill_start_step is None
+                    and self.step_idx > r.deadline_step):
+                self._shed(r, OUTCOME_SHED_DEADLINE)
+            else:
+                kept.append(r)
+        self.queue = kept
+
     def _admit(self) -> None:
+        self._shed_expired()
         if self.mode == "static" and any(s is not None
                                          for s in self.slots):
             return  # run-to-completion barrier: drain first
@@ -164,18 +301,84 @@ class Batcher:
                     f"shard owns only {self.pool_alloc.capacity} — "
                     "it could never be admitted"
                 )
+            # Lazy admission (round 15): reserve only what the prefill
+            # writes — prompt plus any recompute extension — and grow
+            # the rest on demand in _grow_tables. Admission capacity
+            # is the ACTUAL footprint, not the worst case.
+            prefill_len = req.n_prompt + len(req.generated)
+            blocks0 = max(1, -(-prefill_len // self.page_len))
             shard = self._shard_of(i)
             try:
-                pages = self.pool_alloc.alloc_n(blocks, shard)
+                pages = self.pool_alloc.alloc_n(blocks0, shard)
             except OutOfPages:
                 # Head-of-line request does not fit THIS shard's pool;
                 # another free slot may live on a shard with pages.
                 continue
             self.queue.popleft()
-            self.slots[i] = _Slot(req, pages)
+            self.slots[i] = _Slot(req, pages, prefill_len)
             row = np.full(self.max_blocks, TRASH_PAGE, np.int32)
-            row[:blocks] = pages
+            row[:blocks0] = pages
             self.tables[i] = row
+
+    def _next_tokens(self, s: _Slot) -> int:
+        if s.phase == "prefill":
+            return min(self.chunk, s.prefill_len - s.pos)
+        return 1
+
+    def _preempt(self, i: int) -> None:
+        """Evict slot ``i``: free its pages (atomically — the churn
+        invariant), clear its table row, and re-enqueue its request at
+        the queue head for recompute-from-prompt. Completed tokens
+        ride along in ``req.generated`` (consumed by
+        :meth:`Request.full_tokens` at re-admission), so preemption
+        loses schedule steps, never tokens."""
+        s = self.slots[i]
+        req = s.req
+        self.pool_alloc.free(s.pages, self._shard_of(i))
+        self.tables[i] = TRASH_PAGE
+        self.slots[i] = None
+        req.preemptions += 1
+        req.preempt_steps.append(self.step_idx)
+        if req.pending_preempt_step is None:
+            req.pending_preempt_step = self.step_idx
+        self.preempt_events.append({
+            "rid": req.rid, "step": self.step_idx,
+            "generated": len(req.generated),
+        })
+        self.queue.appendleft(req)
+
+    def _grow_tables(self) -> None:
+        """Lazy page growth with preemption-on-exhaustion: before the
+        step runs, every slot whose next tokens cross into an
+        unallocated block allocates it from the shard free list; a dry
+        free list preempts the shard's victim (least tokens generated,
+        ties to the younger request — resilience.choose_victim) and
+        retries. The growing slot itself is a valid victim (it is then
+        simply gone this step); the admission-time capacity check
+        guarantees a sole occupant can always finish, so victim
+        eviction always frees at least one page and the loop
+        terminates."""
+        for i in range(self.slots_n):
+            s = self.slots[i]
+            if s is None:
+                continue
+            n = self._next_tokens(s)
+            if n <= 0:
+                continue
+            need = (s.pos + n - 1) // self.page_len + 1
+            shard = self._shard_of(i)
+            while self.slots[i] is s and len(s.pages) < need:
+                try:
+                    pid = self.pool_alloc.alloc(shard)
+                except OutOfPages:
+                    victim = choose_victim(self.slots, shard,
+                                           self._shard_of)
+                    if victim is None:  # unreachable: slot i occupies
+                        raise
+                    self._preempt(victim)
+                    continue
+                s.pages.append(pid)
+                self.tables[i, len(s.pages) - 1] = pid
 
     def _build_inputs(self):
         c = self.chunk
@@ -185,23 +388,35 @@ class Batcher:
         for i, s in enumerate(self.slots):
             if s is None:
                 continue
-            req = s.req
             pos[i] = s.pos
+            n = self._next_tokens(s)
             if s.phase == "prefill":
-                n = min(c, req.n_prompt - s.pos)
-                tokens[i, :n] = req.prompt[s.pos:s.pos + n]
-                n_active[i] = n
+                src = s.req.full_tokens()
+                tokens[i, :n] = src[s.pos:s.pos + n]
             else:
-                tokens[i, 0] = req.generated[-1]
-                n_active[i] = 1
+                tokens[i, 0] = s.req.generated[-1]
+            n_active[i] = n
         return tokens, pos, n_active
+
+    def _stop_after(self, req: Request) -> bool:
+        """Finished after the token just appended? Length-driven by
+        default; ``stop='eos'`` adds the seeded per-(rid, index) stop
+        draw — value-free, so dry and device batchers agree."""
+        k = len(req.generated)
+        if k >= req.max_new:
+            return True
+        return (self.stop == "eos"
+                and eos_stop(self.stop_seed, req.rid, k,
+                             self.eos_prob))
 
     # ------------------------------------------------------- stepping
 
     def step(self) -> List[Request]:
-        """Admit, run one mixed step, advance every slot; → requests
-        that finished this step (their pages already freed)."""
+        """Admit, grow/preempt, run one mixed step, advance every
+        slot; → requests that finished this step (their pages already
+        freed)."""
         self._admit()
+        self._grow_tables()
         tokens, pos, n_active = self._build_inputs()
         if not int(n_active.sum()):
             # Nothing resident: a pure idle tick (the engine advances
@@ -211,6 +426,8 @@ class Batcher:
             self.idle_steps += 1
             self.step_idx += 1
             return []
+        if self.step_hook is not None:
+            self.step_hook(self.step_idx)
         now = self.clock()
         for s in self.slots:
             if s is not None and s.phase == "prefill" and s.pos == 0 \
@@ -238,9 +455,9 @@ class Batcher:
             req, n = s.req, int(n_active[i])
             s.pos += n
             emitted = None
-            if s.phase == "prefill" and s.pos >= req.n_prompt:
+            if s.phase == "prefill" and s.pos >= s.prefill_len:
                 s.phase = "decode"
-                emitted = n - 1       # last prompt row's logits
+                emitted = n - 1       # last prefilled row's logits
             elif s.phase == "decode":
                 emitted = 0
             if emitted is not None:
@@ -250,9 +467,17 @@ class Batcher:
                     req.t_first_token = now
                     req.first_token_step = self.step_idx
                 req.generated.append(tok)
-                if len(req.generated) >= req.max_new:
+                if req.pending_preempt_step is not None:
+                    # The preemption episode ends at the first token
+                    # emitted after recompute — its step span is the
+                    # serve_preempt_recover_steps sample.
+                    req.preempt_recover_steps.append(
+                        self.step_idx - req.pending_preempt_step)
+                    req.pending_preempt_step = None
+                if self._stop_after(req):
                     req.t_finish = now
                     req.finish_step = self.step_idx
+                    req.outcome = OUTCOME_COMPLETED
                     self.pool_alloc.free(s.pages, self._shard_of(i))
                     self.tables[i] = TRASH_PAGE
                     self.slots[i] = None
@@ -281,7 +506,7 @@ class Batcher:
 
     def run(self, trace: List[Request]) -> List[Request]:
         """Drive a whole step-indexed trace to completion; → finished
-        requests in finish order."""
+        requests in finish order (shed requests land in ``.shed``)."""
         pending = deque(sorted(trace, key=lambda r: (r.arrival_step,
                                                      r.rid)))
         while pending or not self.idle():
@@ -294,34 +519,45 @@ class Batcher:
 def simulate_schedule(trace: List[Request], *, slots: int,
                       page_len: int, num_pages: int, max_blocks: int,
                       chunk: int, mode: str = "continuous",
-                      n_shards: int = 1) -> Dict:
+                      n_shards: int = 1, queue_depth: int = 0,
+                      deadline_steps: int = 0, stop: str = "length",
+                      stop_seed: int = 0, eos_prob: float = 0.0,
+                      pool_clamp: Optional[int] = None) -> Dict:
     """Run the scheduler WITHOUT a device: → the exact per-step input
     sequence the mixed step would see, stacked for replay.
 
     Returns ``{"steps", "idle_steps", "tokens": total processed
     (prompt + generated), "stacked": {tokens/pos/n_active/table:
-    np [N, ...]}, "requests"}``. Valid because scheduling is
-    length-driven (module docstring): the 0-valued placeholder tokens
-    change no slot transition and no page movement.
+    np [N, ...]}, "requests", "shed", "preempt_events",
+    "preemptions"}``. Valid because scheduling is length-driven
+    (module docstring): the 0-valued placeholder tokens change no
+    slot transition, no page movement, no preemption, and no seeded
+    stop decision.
     """
-    trace = [dataclasses.replace(r, generated=[]) for r in trace]
+    trace = [r.fresh() for r in trace]
     b = Batcher(None, None, None,
                 slots=slots, page_len=page_len, num_pages=num_pages,
                 max_blocks=max_blocks, chunk=chunk, mode=mode,
-                dry=True, n_shards=n_shards)
+                dry=True, n_shards=n_shards, queue_depth=queue_depth,
+                deadline_steps=deadline_steps, stop=stop,
+                stop_seed=stop_seed, eos_prob=eos_prob,
+                pool_clamp=pool_clamp)
     finished = b.run(trace)
     sched = b.schedule
     stacked = {
         k: np.stack([st[k] for st in sched])
         for k in ("tokens", "pos", "n_active", "table")
     } if sched else {}
-    tokens = sum(r.n_prompt + r.max_new for r in finished)
+    tokens = sum(r.n_prompt + len(r.generated) for r in finished)
     return {
         "steps": len(sched),
         "idle_steps": b.idle_steps,
         "tokens": tokens,
         "stacked": stacked,
         "requests": finished,
+        "shed": b.shed,
+        "preempt_events": b.preempt_events,
+        "preemptions": len(b.preempt_events),
     }
 
 

@@ -57,22 +57,32 @@ def serve_mesh(n_devices: int, devices=None):
                 ("dp",))
 
 
+def sample_request(rng, sc: ServeConfig, rid: int,
+                   arrival_step: int) -> Request:
+    """One synthetic request off ``rng``: prompt/output lengths
+    uniform over the configured ranges, prompt ids uniform over the
+    vocab — the ONE sampling rule, shared by :func:`synthetic_trace`
+    and the storm-burst fault (:func:`tpu_p2p.serve.resilience.
+    storm_burst`), so burst requests can never silently diverge in
+    shape from trace requests."""
+    p = int(rng.integers(sc.prompt_len[0], sc.prompt_len[1] + 1))
+    g = int(rng.integers(sc.gen_len[0], sc.gen_len[1] + 1))
+    prompt = rng.integers(0, sc.vocab, p).astype(np.int32)
+    return Request(rid=rid, prompt=prompt, max_new=g,
+                   arrival_step=arrival_step)
+
+
 def synthetic_trace(sc: ServeConfig) -> List[Request]:
     """Seeded many-request trace: exponential inter-arrival gaps (a
     Poisson process) measured in SCHEDULER STEPS — deterministic for a
     seed, so step counts and the A/B comparison cannot drift with host
-    speed — prompt/output lengths uniform over the configured ranges,
-    prompt ids uniform over the vocab."""
+    speed — per-request shape via :func:`sample_request`."""
     rng = np.random.default_rng(sc.seed)
     t = 0.0
     reqs = []
     for i in range(sc.requests):
         t += rng.exponential(1.0 / sc.rate)
-        p = int(rng.integers(sc.prompt_len[0], sc.prompt_len[1] + 1))
-        g = int(rng.integers(sc.gen_len[0], sc.gen_len[1] + 1))
-        prompt = rng.integers(0, sc.vocab, p).astype(np.int32)
-        reqs.append(Request(rid=i, prompt=prompt, max_new=g,
-                            arrival_step=int(t)))
+        reqs.append(sample_request(rng, sc, i, int(t)))
     return reqs
 
 
@@ -95,6 +105,15 @@ def _request_record(r: Request) -> dict:
         "ttft_ms": ms(r.t_enqueue, r.t_first_token),
         "decode_ms": ms(r.t_first_token, r.t_finish),
         "total_ms": ms(r.t_enqueue, r.t_finish),
+        # Resilience verdict fields (round 15): outcome is
+        # "completed" or a shed verdict ("shed_admission" /
+        # "shed_deadline" + shed_step) — the signal `obs watch`
+        # alerts on; preemptions counts evictions the request
+        # survived (zero token loss by contract).
+        "outcome": r.outcome,
+        "shed_step": r.shed_step,
+        "deadline_step": r.deadline_step,
+        "preemptions": r.preemptions,
     }
 
 
@@ -109,14 +128,27 @@ def run_engine(mesh, cfg, params, trace: List[Request], *,
     :class:`~tpu_p2p.obs.ledger.CollectiveLedger` — the mixed step is
     then TRACED under recording, so its collective issues (tp joins,
     ep reshards) land in the ledger like a training step's.
-    """
-    import dataclasses as _dc
 
-    trace = [_dc.replace(r, generated=[]) for r in trace]
+    Resilience (round 15): the batcher runs with ``sc``'s admission/
+    deadline/stop knobs, pages grow lazily with
+    preemption-on-exhaustion, and an active fault plan is applied
+    through :func:`tpu_p2p.serve.resilience.apply_serve_faults`
+    (page-pool clamp, request storm, slow-step hook). Shed requests
+    emit ``{"obs": "request"}`` records with their shed verdict; the
+    returned dict carries the JSON summary PLUS the ``finished`` /
+    ``shed_requests`` request lists (not emitted) for graders.
+    """
+    from tpu_p2p.serve import resilience as R
+
+    trace = [r.fresh() for r in trace]
+    trace, pool_clamp, step_hook = R.apply_serve_faults(trace, sc)
     batcher = Batcher(
         mesh, cfg, params, slots=sc.slots, page_len=sc.page_len,
         num_pages=sc.num_pages, max_blocks=sc.max_blocks,
-        chunk=sc.chunk, mode=mode, clock=clock)
+        chunk=sc.chunk, mode=mode, queue_depth=sc.queue_depth,
+        deadline_steps=sc.deadline_steps, stop=sc.stop,
+        stop_seed=sc.seed, eos_prob=sc.eos_prob,
+        pool_clamp=pool_clamp, step_hook=step_hook, clock=clock)
     t0 = clock()
     if ledger is not None:
         from tpu_p2p.obs.ledger import recording
@@ -137,6 +169,7 @@ def run_engine(mesh, cfg, params, trace: List[Request], *,
               / (len(r.generated) - 1)
               for r in finished
               if len(r.generated) > 1 and r.t_finish is not None]
+    shed = batcher.shed
     summary = {
         "mode": mode,
         "requests": len(finished),
@@ -151,9 +184,15 @@ def run_engine(mesh, cfg, params, trace: List[Request], *,
         "serve_ttft_ms_p99": _r3(percentile(ttft, 0.99)),
         "serve_tok_ms_p50": _r3(percentile(tok_ms, 0.50)),
         "serve_tok_ms_p99": _r3(percentile(tok_ms, 0.99)),
+        "shed": len(shed),
+        "shed_frac": round(len(shed) / max(len(trace), 1), 4),
+        "preemptions": len(batcher.preempt_events),
+        "preempt_recover_steps": R.preempt_recover_steps(finished),
     }
     if emit is not None:
         for r in finished:
+            emit(_request_record(r))
+        for r in shed:
             emit(_request_record(r))
         emit({"obs": "serve_summary", **summary})
         if ledger is not None:
@@ -162,7 +201,7 @@ def run_engine(mesh, cfg, params, trace: List[Request], *,
             from tpu_p2p.obs.ledger import totals_record
 
             emit(totals_record(ledger))
-    return summary
+    return {**summary, "finished": finished, "shed_requests": shed}
 
 
 def _r3(v):
@@ -214,14 +253,31 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="synthetic vocabulary size")
     p.add_argument("--dtype", default="float32",
                    help="model/cache dtype")
-    from tpu_p2p.config import BATCHING
+    from tpu_p2p.config import BATCHING, SERVE_STOPS
 
     p.add_argument("--batching", default="both", choices=BATCHING,
                    help="batching mode(s) to run — 'both' prints the "
                         "A/B on the same trace")
+    p.add_argument("--queue-depth", type=int, default=0,
+                   help="bounded admission queue (0 = unbounded); "
+                        "overflow sheds with outcome shed_admission")
+    p.add_argument("--deadline-steps", type=int, default=0,
+                   help="admission deadline in scheduler steps (0 = "
+                        "none); unserved queued requests shed with "
+                        "outcome shed_deadline")
+    p.add_argument("--stop", default="length", choices=SERVE_STOPS,
+                   help="stop rule: exact max-new lengths, or seeded "
+                        "per-token EOS draws (deterministic replay "
+                        "either way)")
+    p.add_argument("--eos-prob", type=float, default=0.1,
+                   help="--stop eos: per-token stop probability")
     p.add_argument("--obs-jsonl", default=None, metavar="PATH",
                    help="append per-request span records + the serve "
                         "summary to this JSONL timeline")
+    p.add_argument("--chaos", action="store_true",
+                   help="run the injected-fault chaos smoke instead "
+                        "of a plain trace (make serve-chaos; "
+                        "docs/serving_resilience.md)")
     p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
                    help="testing: force CPU platform with N simulated "
                         "devices")
@@ -229,8 +285,17 @@ def _build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    args = _build_parser().parse_args(
-        list(sys.argv[1:] if argv is None else argv))
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--chaos" in argv:
+        # The injected-fault chaos smoke (docs/serving_resilience.md)
+        # — its own grading path with its own parser, like `obs
+        # smoke` next to `obs`: the remaining argv is handed over
+        # whole, so `--detect-steps` works and an engine-only flag
+        # (e.g. --rate) fails loudly instead of silently dropping.
+        from tpu_p2p.serve.resilience import chaos_main
+
+        return chaos_main([a for a in argv if a != "--chaos"])
+    args = _build_parser().parse_args(argv)
     from tpu_p2p.utils.errors import fail_fast
 
     try:
@@ -260,6 +325,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             batching=args.batching, requests=args.requests,
             seed=args.seed, rate=args.rate, prompt_len=prompt_rng,
             gen_len=gen_rng, vocab=args.vocab, dtype=args.dtype,
+            queue_depth=args.queue_depth,
+            deadline_steps=args.deadline_steps, stop=args.stop,
+            eos_prob=args.eos_prob,
         )
         cfg = _engine_model(sc)
         params = F.place_flagship_params(F.init_flagship_params(cfg),
@@ -310,6 +378,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                       f"p99 {_f(s['serve_ttft_ms_p99'])}ms  "
                       f"tok p50 {_f(s['serve_tok_ms_p50'])}ms "
                       f"p99 {_f(s['serve_tok_ms_p99'])}ms")
+                if s["shed"] or s["preemptions"]:
+                    # Resilience verdicts, printed only when they
+                    # fired (a clean trace keeps the round-13 output
+                    # contract byte-identical).
+                    print(f"  shed={s['shed']} "
+                          f"(frac {s['shed_frac']:.2f})  "
+                          f"preemptions={s['preemptions']} "
+                          f"recover_steps="
+                          f"{s['preempt_recover_steps']}")
             if len(modes) == 2:
                 # The deterministic A/B: non-idle scheduler step
                 # counts on the same trace (host-speed-independent,

@@ -108,11 +108,39 @@ class PagePool:
             for _ in range(n_shards)
         ]
         self._allocated = [set() for _ in range(n_shards)]
+        self._usable = per_shard - 1
 
     @property
     def capacity(self) -> int:
-        """Usable pages per shard (the trash page is not usable)."""
-        return self.pages_per_shard - 1
+        """Usable pages per shard (the trash page is not usable; a
+        :meth:`clamp_capacity` fault shrinks this further)."""
+        return self._usable
+
+    def clamp_capacity(self, usable: int) -> None:
+        """Withhold pages so at most ``usable`` per shard are ever
+        allocatable — the serve-scoped page-pool-clamp fault's
+        application point (:mod:`tpu_p2p.obs.faults`
+        ``page_pool_clamp``, applied by ``serve/resilience.py`` at
+        batcher construction). Withheld pages leave the free list for
+        good, so every alloc/free invariant (and the drain-to-full
+        leak check, now against the CLAMPED capacity) keeps holding.
+        Construction-time only: clamping a pool with live allocations
+        would make "exactly full again" ambiguous.
+        """
+        if usable < 1:
+            raise ValueError(
+                f"clamp must leave >= 1 usable page per shard, got "
+                f"{usable}"
+            )
+        if any(self._allocated):
+            raise RuntimeError(
+                "clamp_capacity applies at construction, before any "
+                "page is handed out"
+            )
+        usable = min(usable, self.pages_per_shard - 1)
+        for shard in range(self.n_shards):
+            del self._free[shard][: len(self._free[shard]) - usable]
+        self._usable = usable
 
     def available(self, shard: int = 0) -> int:
         return len(self._free[shard])
@@ -137,12 +165,28 @@ class PagePool:
         return [self.alloc(shard) for _ in range(n)]
 
     def free(self, pages: Sequence[int], shard: int = 0) -> None:
+        """Return ``pages`` to the shard's free list — atomically.
+
+        The whole sequence is validated BEFORE any page moves: a bad
+        entry (double free, trash page, out of range, or the same
+        page twice in one call) leaves the pool byte-identical, so a
+        caller that catches the error still holds a consistent view
+        — the preempt/free/realloc churn invariant
+        (tests/test_serve.py). Round 13's loop freed page-by-page:
+        ``free([good, bad])`` freed ``good``, then raised, and a
+        retry of the same list double-freed it.
+        """
+        pages = list(pages)
+        seen: set = set()
         for pid in pages:
-            if pid not in self._allocated[shard]:
+            if pid not in self._allocated[shard] or pid in seen:
                 raise ValueError(
                     f"shard {shard}: page {pid} is not allocated "
-                    "(double free, trash page, or out of range)"
+                    "(double free, trash page, out of range, or "
+                    "repeated in this call) — nothing was freed"
                 )
+            seen.add(pid)
+        for pid in pages:
             self._allocated[shard].remove(pid)
             self._free[shard].append(pid)
 
