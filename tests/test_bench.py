@@ -902,32 +902,112 @@ def test_pp_sched_measured_failure_keeps_analytic_keys(monkeypatch):
     assert "zb schedule lost" in out["sched_error"]
 
 
-@pytest.mark.slow  # tier-1 budget (round 14): two full pp=8 MANUAL
-# flagship executor compiles (per-tick vjp); the zb path's tier-1
+@pytest.mark.slow  # tier-1 budget (round 14): three full pp=8 MANUAL
+# flagship executor compiles (per-tick vjp); the switch path's tier-1
 # compile coverage rides tests/test_schedule.py::
-# test_flagship_zb_matches_1f1b_pp2 and the schema/null wiring is
-# pinned by SCHED_NULL's use in bench main().
+# test_flagship_switch_matches_legacy_pp2 and the schema/null wiring
+# is pinned by SCHED_NULL's use in bench main() + the stubbed-arm
+# tests below.
 def test_pp_sched_metrics_cpu_mesh(monkeypatch):
-    # The schedule-IR twin of test_pp_overlap_metrics_cpu_mesh: both
-    # pp_schedule modes build + run a real pp=8 manual-executor step
-    # (the dB/dW split's compile coverage on the full visible mesh),
-    # the losses agree bitwise, the analytic fracs publish, and the
-    # measured pair comes back from the stubbed slope.
+    # The schedule-IR twin of test_pp_overlap_metrics_cpu_mesh: the
+    # fused production arm, the zb switch arm, and the switch-lowered
+    # fused companion all build + run a real pp=8 manual-executor
+    # step, the losses agree bitwise, the analytic fracs publish, and
+    # the measured pair comes back from the stubbed slopes (round 16:
+    # descending, so the zb-beats-fused grading passes — the REAL
+    # wall-clock claim is pinned by tests/test_schedule.py::
+    # test_zb_switch_beats_fused_1f1b_measured_8dev).
     from tpu_p2p.utils import timing
+
+    slopes = iter([3e-3, 2e-3, 1.5e-3])
 
     monkeypatch.setattr(
         bench, "_measure",
         lambda t, mc, x, iters, repeats=3, runs=2:
-            _fake_headline(host=2e-3),
+            _fake_headline(host=next(slopes)),
     )
     out = bench._pp_sched_metrics(timing)
     assert out["sched_devices"] == 8
     assert out["pp_bubble_frac_zb"] < out["pp_bubble_frac_1f1b"]
-    assert out["pp_step_ms_sched_1f1b"] == pytest.approx(2.0)
+    assert out["pp_step_ms_sched_1f1b"] == pytest.approx(3.0)
     assert out["pp_step_ms_sched_zb"] == pytest.approx(2.0)
+    assert out["pp_step_ms_sched_1f1b_switch"] == pytest.approx(1.5)
+    assert out["sched_lowering"] == "switch"
     assert out["sched_source"] == "host_differential"
     assert out["sched_error"] is None
     assert set(out) == set(bench.SCHED_NULL)
+
+
+def _fake_sched_arm(fail_lowerings=(), ms={"masked": 5.0,
+                                           "switch": 2.0}):
+    def arm(timing, mesh, n, mode, lowering):
+        if lowering in fail_lowerings:
+            raise RuntimeError(f"{lowering} arm exploded")
+        return ms[lowering] + (1.0 if mode == "1f1b" else 0.0), \
+            "host_differential", 1.25
+    return arm
+
+
+def test_pp_sched_measured_grades_the_switch_pair(monkeypatch):
+    # Stubbed-arm wiring test (device-free): the graded pair is the
+    # fused production step (masked) vs the zb route (switch), the
+    # lowering publishes, and the switch-lowered fused companion
+    # lands in detail.
+    from jax.sharding import Mesh
+
+    import jax
+    import numpy as np
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("pp",))
+    monkeypatch.setattr(bench, "_pp_sched_arm",
+                        _fake_sched_arm())
+    out = bench._pp_sched_measured(None, mesh, 8)
+    assert out["pp_step_ms_sched_1f1b"] == pytest.approx(6.0)
+    assert out["pp_step_ms_sched_zb"] == pytest.approx(2.0)
+    assert out["pp_step_ms_sched_1f1b_switch"] == pytest.approx(3.0)
+    assert out["sched_lowering"] == "switch"
+    assert "sched_error" not in out
+
+
+def test_pp_sched_measured_masked_fallback_names_the_lowering(
+        monkeypatch):
+    # Round-16 satellite: a switch-arm failure must NOT dead-end —
+    # the masked fallback still measures (proving the executor), the
+    # pair nulls under the SCHED_NULL schema, and sched_lowering /
+    # sched_error name the lowering that actually ran and why it
+    # cannot grade.
+    from jax.sharding import Mesh
+
+    import jax
+    import numpy as np
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("pp",))
+    monkeypatch.setattr(bench, "_pp_sched_arm",
+                        _fake_sched_arm(fail_lowerings=("switch",)))
+    out = bench._pp_sched_measured(None, mesh, 8)
+    assert out["pp_step_ms_sched_1f1b"] is None
+    assert out["pp_step_ms_sched_zb"] is None
+    assert out["sched_lowering"] == "masked"
+    assert "switch arm exploded" in out["sched_error"]
+    assert "masked" in out["sched_error"]
+
+
+def test_pp_sched_measured_zb_loss_is_a_real_failure(monkeypatch):
+    # When the switch arm runs but zb does NOT beat the fused step on
+    # a pp>1 mesh, that is a genuine switch-path regression (not the
+    # old masked by-construction loss) — the metric raises and the
+    # outer handler nulls the pair with the reason.
+    from jax.sharding import Mesh
+
+    import jax
+    import numpy as np
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("pp",))
+    monkeypatch.setattr(
+        bench, "_pp_sched_arm",
+        _fake_sched_arm(ms={"masked": 2.0, "switch": 4.0}))
+    with pytest.raises(RuntimeError, match="switch lowering"):
+        bench._pp_sched_measured(None, mesh, 8)
 
 
 def test_compact_line_fits_with_every_headline_key_at_realistic_width():

@@ -73,6 +73,18 @@ SUMMARY_PATTERNS = {
     # matrix itself lives in tests/test_schedule.py).
     "flagship_zb": ["--cpu-mesh", "8", "--pattern", "flagship_step",
                     "--pp-schedule", "zb", "--iters", "2"],
+    # The round-16 tick_lowering knob end to end: --tick-lowering
+    # switch runs the zero-bubble program under the cost-proportional
+    # per-rank lax.switch dispatch (idle ranks genuinely idle). Like
+    # the zb pin, build_mesh lands pp=2 on 8 devices, so this runs a
+    # REAL dispatched dB/dW split end to end — plumbing, the
+    # tick_lowering=switch output contract, and the switch executor
+    # under the full 5-axis mesh (the bitwise masked-vs-switch parity
+    # matrix itself lives in tests/test_schedule.py).
+    "flagship_zb_switch": ["--cpu-mesh", "8", "--pattern",
+                           "flagship_step", "--pp-schedule", "zb",
+                           "--tick-lowering", "switch",
+                           "--iters", "2"],
     # The round-11 pallas_dma transport end to end on the 8-device
     # mesh: the full uni-directional matrix over raw async-remote-copy
     # kernels (interpret mode on CPU), --check asserting every cell's

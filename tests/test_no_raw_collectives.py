@@ -90,8 +90,21 @@ def test_lint_scans_the_expected_trees():
     # itself (schedule.py tick_grads_local / tick_forward_local) — a
     # raw collective there would leak the WHOLE pipeline transport of
     # any IR-compiled schedule past the ledger, so its lowering must
-    # stay inside the scanned tree.
+    # stay inside the scanned tree. Round 16's cost-proportional
+    # switch dispatch lives in the same module (the lax.switch branch
+    # bodies plus the hops OUTSIDE them — a raw ppermute smuggled
+    # into a branch would both leak past the ledger and deadlock
+    # rank-divergent control flow), so the scanned set must keep
+    # covering it AND actually contain the dispatch paths.
     assert "schedule.py" in names, sorted(names)
+    sched_src = next(p for p in files
+                     if os.path.basename(p) == "schedule.py")
+    with open(sched_src) as fh:
+        sched_text = fh.read()
+    assert "tick_switch" in sched_text and "op_code" in sched_text, (
+        "the switch dispatch moved out of models/schedule.py — "
+        "extend SCANNED (and this self-test) to wherever it went"
+    )
     # The round-13 serve tree is covered (paged_cache.py issues the
     # decode psum joins through the wrappers; a regression that drops
     # serve/ from SCANNED must fail here, not ship silently). Round

@@ -4,6 +4,14 @@ the tentpole equivalence contract — every legacy executor BITWISE
 equal to its compiled IR program, and the zero-bubble (ZB-H1-style)
 dB/dW split BITWISE equal to the fused 1F1B step it reschedules.
 
+Round 16 adds the cost-proportional tick lowering's contract: the
+``tick_lowering="switch"`` per-rank lax.switch dispatch is BITWISE
+the masked execution for every program kind on every parity mesh
+(GPipe autodiff, fused 1F1B/interleaved, the zb split, S=1 degrades,
+wave compose), and on the 8-dev pure-pp CPU mesh the zb route under
+switch beats the fused production step's measured wall clock — the
+regression the bench pair now grades.
+
 Reuses the shared schedule-parity harness in tests/conftest.py
 (parity_mesh / pipeline_setup / flagship_cfg /
 assert_flagship_step_parity — the round-14 satellite that de-duplicated
@@ -301,6 +309,287 @@ def test_pp_schedule_knob_is_validated():
     with _pytest.raises(ValueError, match="chunks=1"):
         F.make_flagship_train_step_1f1b(
             mesh, flagship_cfg(pp_schedule="zb", stages=4), chunks=2)
+
+
+# ------------------------------------- cost-proportional switch lowering
+
+
+def test_switch_lowering_tables_index_a_compact_op_table():
+    # The per-rank timeline: op_code [T, n] indexes the program's
+    # compact op table (noop always first, then only the kinds the
+    # program issues), reproducing the tick ops exactly.
+    for prog in (S.compile_gpipe(3, 4), S.compile_1f1b(3, 4),
+                 S.compile_interleaved(4, 2, 2), S.compile_zb(4, 4)):
+        lowered = S.lower(prog, tick_lowering="switch")
+        assert lowered.lowering == "switch"
+        assert lowered.op_table[0] == "noop"
+        kinds = {op.kind for t in prog.ticks for op in t.compute}
+        assert set(lowered.op_table) == {"noop"} | kinds
+        code = lowered.tables["op_code"]
+        assert code.shape == (prog.num_ticks, prog.devices)
+        want = np.zeros_like(code)
+        for t, tick in enumerate(prog.ticks):
+            for op in tick.compute:
+                want[t, op.device] = lowered.op_table.index(op.kind)
+        np.testing.assert_array_equal(code, want, err_msg=prog.name)
+    # zb's table is exactly the issue's compact quartet.
+    assert S.lower(S.compile_zb(4, 4),
+                   tick_lowering="switch").op_table == (
+        "noop", "fwd", "bwd_input", "bwd_weight")
+
+
+def test_masked_lowering_tables_stay_byte_identical():
+    # The default lowering must not grow an op_code table (the legacy
+    # round-14 table family, byte for byte) — existing executors and
+    # cache keys see no change.
+    prog = S.compile_zb(4, 4)
+    lowered = S.lower(prog)
+    assert lowered.lowering == "masked"
+    assert "op_code" not in lowered.tables
+    assert lowered.op_table == ("noop",)
+
+
+def test_lower_rejects_unknown_lowering():
+    with pytest.raises(ValueError, match="tick_lowering"):
+        S.lower(S.compile_1f1b(2, 2), tick_lowering="select")
+
+
+@pytest.mark.parametrize("make,mesh_shape,place_chunks", [
+    (lambda: S.compile_gpipe(4, 4), (4,), None),
+    (lambda: S.compile_1f1b(4, 4), (4,), None),
+    (lambda: S.compile_interleaved(4, 2, 2), (2,), 2),
+    (lambda: S.compile_zb(4, 4), (4,), None),
+    (lambda: S.compile_zb(4, 2), (2,), None),
+    (lambda: S.compile_zb(4, 1), (1,), None),
+], ids=["gpipe", "1f1b", "interleaved", "zb4", "zb2", "zb-s1"])
+def test_switch_lowering_step_matches_masked_bitwise(make, mesh_shape,
+                                                     place_chunks):
+    # The tentpole contract: the switch dispatch runs the SAME ops on
+    # the SAME operands in the SAME order as the masked execution —
+    # loss and every updated param bitwise, for every program kind
+    # (autodiff-through-switch for GPipe, fused vjp ticks, the zb
+    # split with its stash rewrite) incl. the S=1 degenerate.
+    prog = make()
+    stages = prog.devices * prog.chunks
+    cfg, params, x, target = pipeline_setup(stages=stages,
+                                            m=prog.microbatches)
+    mesh = parity_mesh(("pp",), mesh_shape)
+    if place_chunks:
+        placed = IL.place_interleaved_params(params, mesh,
+                                            place_chunks)
+    else:
+        placed = PL.place_pipeline_params(params, mesh)
+    p_m, l_m = S.make_tick_train_step(mesh, cfg, make(), lr=5e-2)(
+        placed, x, target)
+    p_s, l_s = S.make_tick_train_step(
+        mesh, cfg, make(), lr=5e-2, tick_lowering="switch")(
+        placed, x, target)
+    assert float(l_s) == float(l_m)
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(p_s[k]), np.asarray(p_m[k]), err_msg=k)
+
+
+def test_switch_lowering_composes_with_wave_bitwise():
+    # switch x wave: the hops stay outside the lax.switch (every rank
+    # joins every tick's ppermute), so the token-chunk wave lowering
+    # of the ship site composes bitwise with the per-rank dispatch.
+    cfg, params, x, target = pipeline_setup(stages=4, m=4)
+    mesh = parity_mesh(("pp",), (4,))
+    placed = PL.place_pipeline_params(params, mesh)
+    p_m, l_m = S.make_tick_train_step(mesh, cfg, S.compile_zb(4, 4),
+                                      lr=5e-2)(placed, x, target)
+    p_s, l_s = S.make_tick_train_step(
+        mesh, cfg, S.compile_zb(4, 4), lr=5e-2,
+        tick_lowering="switch", pp_overlap="wave", pp_chunks=3)(
+        placed, x, target)
+    assert float(l_s) == float(l_m)
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(p_s[k]), np.asarray(p_m[k]), err_msg=k)
+
+
+def test_flagship_switch_matches_legacy_pp2():
+    # The flagship contract on a pure-pp mesh, BOTH schedules: the
+    # manual executor under tick_lowering="switch" (full transformer
+    # block per tick inside the dispatched branches) is bitwise the
+    # default masked/legacy step.
+    assert_flagship_step_parity(
+        parity_mesh(("pp",), (2,)), flagship_cfg(),
+        flagship_cfg(tick_lowering="switch"), one_f1b=True)
+    assert_flagship_step_parity(
+        parity_mesh(("pp",), (2,)), flagship_cfg(pp_schedule="zb"),
+        flagship_cfg(pp_schedule="zb", tick_lowering="switch"),
+        one_f1b=True)
+
+
+@pytest.mark.slow  # tier-1 budget: the mesh/remat matrix rides the
+# uncapped full pass; tier-1 keeps the pp2 cases + validation.
+@pytest.mark.parametrize(
+    "names,shape,kw",
+    [(("dp", "pp"), (2, 2), {}), (("tp", "pp"), (2, 2), {}),
+     (("ep", "pp"), (2, 2), dict(dense_ffn=True)),
+     (("pp",), (4,), dict(stages=4, microbatches=4)),
+     (("dp", "pp"), (2, 2), dict(remat=True)),
+     (("pp",), (2,), dict(seq=17))],
+    ids=["dp2xpp2", "tp2xpp2", "ep2-dense", "pp4", "remat",
+         "oddseq"])
+def test_flagship_zb_switch_matches_fused_meshes(names, shape, kw):
+    # The round-14 zb mesh matrix re-run against the switch lowering:
+    # dp x pp (data-sharded carries through the branches), tp x pp
+    # (tp-varying dW typing), pp4 (deep drain), remat (checkpointed
+    # block inside dispatched vjps), odd seq (padding through the
+    # ships) — all bitwise vs the fused legacy step.
+    assert_flagship_step_parity(
+        parity_mesh(names, shape), flagship_cfg(**kw),
+        flagship_cfg(**kw, pp_schedule="zb", tick_lowering="switch"),
+        one_f1b=True)
+
+
+@pytest.mark.slow
+def test_flagship_switch_composes_with_wave():
+    assert_flagship_step_parity(
+        parity_mesh(("pp",), (2,)), flagship_cfg(),
+        flagship_cfg(pp_schedule="zb", tick_lowering="switch",
+                     pp_overlap="wave", pp_chunks=2),
+        one_f1b=True)
+
+
+def test_switch_rejects_permute_collectives_inside_the_block():
+    # Rank-divergent lax.switch branches cannot contain a
+    # collective-permute (ONE whole-mesh instruction — ranks in other
+    # branches never reach its rendezvous and the step deadlocks), so
+    # the manual executor rejects switch wherever the stage block
+    # ships permutes: sp attention rings, MoE ep reshards, the
+    # tp-ring collective-matmul overlap. Group-scoped reductions are
+    # safe — tp x pp (psum joins) and ep x pp under dense_ffn (pure
+    # data sharding) stay bitwise in the parity matrix.
+    from tpu_p2p.models import flagship as F
+
+    for names, shape, kw in [
+        (("sp", "pp"), (2, 2), {}),
+        (("ep", "pp"), (2, 2), {}),
+        (("tp", "pp"), (2, 2), dict(tp_overlap="ring")),
+    ]:
+        with pytest.raises(ValueError, match="permute-family"):
+            F.make_flagship_train_step_1f1b(
+                parity_mesh(names, shape),
+                flagship_cfg(tick_lowering="switch", **kw))
+
+
+def test_tick_lowering_knob_is_validated():
+    from tpu_p2p.config import BenchConfig
+    from tpu_p2p.models import flagship as F
+
+    with pytest.raises(ValueError, match="tick_lowering"):
+        flagship_cfg(tick_lowering="Switch")
+    with pytest.raises(ValueError, match="tick_lowering"):
+        BenchConfig(tick_lowering="select")
+    assert BenchConfig(tick_lowering="switch").tick_lowering == \
+        "switch"
+    # The GPipe autodiff steps reject switch loudly — their schedule
+    # is a masked scan autodiff owns, and a switch label there would
+    # silently time the masked baseline (the strict-knob class).
+    mesh = parity_mesh(("pp",), (2,))
+    with pytest.raises(ValueError, match="manual"):
+        F.make_flagship_train_step(
+            mesh, flagship_cfg(tick_lowering="switch"))
+    with pytest.raises(ValueError, match="manual"):
+        F.make_flagship_lm_train_step(
+            mesh, flagship_cfg(tick_lowering="switch", vocab=32))
+
+
+def test_price_program_per_rank_idle_spans():
+    # The round-16 obs satellite: price_program decomposes the bubble
+    # to the rank whose wall clock it is — per-rank busy/idle costs,
+    # explicit idle [start, end) tick spans, and per-rank fracs whose
+    # mean IS bubble_fraction.
+    for prog in (S.compile_1f1b(4, 4), S.compile_zb(4, 4),
+                 S.compile_gpipe(4, 4)):
+        bill = S.price_program(prog, payload_bytes=512)
+        per_rank = bill["per_rank"]
+        assert [r["device"] for r in per_rank] == list(
+            range(prog.devices))
+        assert np.mean([r["bubble_frac"] for r in per_rank]) == \
+            pytest.approx(S.bubble_fraction(prog))
+        for r in per_rank:
+            # Spans are maximal, disjoint, in-range, and cover
+            # exactly the ticks where the rank issues no op.
+            idle_ticks = set()
+            prev_end = -1
+            for s0, s1 in r["idle_spans"]:
+                assert 0 <= s0 < s1 <= prog.num_ticks
+                assert s0 > prev_end  # maximal: no adjacent spans
+                prev_end = s1
+                idle_ticks.update(range(s0, s1))
+            want_idle = {
+                t for t, tick in enumerate(prog.ticks)
+                if not any(op.device == r["device"]
+                           for op in tick.compute)
+            }
+            assert idle_ticks == want_idle, (prog.name, r["device"])
+            assert r["busy_cost"] + r["idle_cost"] == pytest.approx(
+                sum(max((S.OP_COST[op.kind] for op in t.compute),
+                        default=1.0) for t in prog.ticks))
+    # The zb program idles less than fused 1F1B on every rank's own
+    # account too, not just in aggregate.
+    zb = S.price_program(S.compile_zb(4, 4), 512)["per_rank"]
+    f1 = S.price_program(S.compile_1f1b(4, 4), 512)["per_rank"]
+    assert sum(r["idle_cost"] for r in zb) < sum(
+        r["idle_cost"] for r in f1)
+
+
+@pytest.mark.slow  # two full pp=8 manual flagship compiles — the
+# round-16 acceptance regression: with idle ranks genuinely idle the
+# zb route must BEAT the fused production step's measured wall clock
+# on the 8-dev pure-pp CPU mesh (the pair bench now grades; through
+# round 15 the masked execution lost this by construction).
+def test_zb_switch_beats_fused_1f1b_measured_8dev():
+    import time
+
+    import jax
+
+    from tpu_p2p.models import flagship as F
+
+    mesh = parity_mesh(("pp",), (8,))
+
+    def build(mode, lowering):
+        cfg = F.FlagshipConfig(
+            batch=4, seq=64, heads=4, head_dim=32, stages=8,
+            microbatches=4, dense_ffn=True, moe_mult=2,
+            dtype="float32", pp_schedule=mode,
+            tick_lowering=lowering)
+        params = F.place_flagship_params_pipelined(
+            F.init_flagship_params(cfg), mesh, cfg)
+        x, t = F.flagship_example_batch(cfg, mesh)
+        return F.make_flagship_train_step_1f1b(mesh, cfg, lr=1e-2), \
+            params, x, t
+
+    def best_ms(step, params, x, t, steps=6, reps=3):
+        jax.block_until_ready(step(params, x, t)[0])  # compile
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            p = params
+            for _ in range(steps):
+                p, loss = step(p, x, t)
+            jax.block_until_ready(loss)
+            best = min(best, (time.perf_counter() - t0) / steps)
+        return best * 1e3
+
+    s_f, p_f, x, t = build("1f1b", "masked")
+    s_z, p_z, _x, _t = build("zb", "switch")
+    # Bitwise first (the parity matrix at the bench shape) — a timing
+    # claim over diverging steps would be meaningless.
+    l_f = float(s_f(p_f, x, t)[1])
+    l_z = float(s_z(p_z, x, t)[1])
+    assert l_z == l_f
+    ms_f = best_ms(s_f, p_f, x, t)
+    ms_z = best_ms(s_z, p_z, x, t)
+    # Measured ~2.9x on this mesh; 1.3x floor keeps the pin robust to
+    # CI noise while still failing if the switch dispatch regresses
+    # to anything masked-shaped.
+    assert ms_z * 1.3 < ms_f, (ms_z, ms_f)
 
 
 # ----------------------------------------------------- executor guards

@@ -25,6 +25,7 @@ from tpu_p2p.config import (
     MODES,
     PATTERNS,
     PP_SCHEDULES,
+    TICK_LOWERINGS,
     TRANSPORTS,
     parse_size,
     parse_sweep,
@@ -135,6 +136,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "split — weight-grad ticks fill the 1F1B "
                         "bubbles, step bitwise vs 1f1b; routes the "
                         "workload through the manual 1F1B executor)")
+    p.add_argument("--tick-lowering", choices=TICK_LOWERINGS,
+                   default="masked",
+                   help="flagship_step: tick lowering for the manual "
+                        "executor's compiled programs (switch = "
+                        "cost-proportional per-rank lax.switch "
+                        "dispatch — idle ranks genuinely idle, step "
+                        "bitwise vs masked; routes the workload "
+                        "through the manual executor even under "
+                        "--pp-schedule 1f1b)")
     p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
                    help="testing: force CPU platform with N simulated devices")
     p.add_argument("--list-devices", action="store_true",
@@ -178,6 +188,7 @@ def config_from_args(args: argparse.Namespace) -> BenchConfig:
         ep_overlap=args.ep_overlap,
         pp_overlap=args.pp_overlap,
         pp_schedule=args.pp_schedule,
+        tick_lowering=args.tick_lowering,
     )
 
 

@@ -123,6 +123,21 @@ ISOLATIONS = ("full", "submesh")  # SURVEY.md §7 hard part (a)
 DIRECTIONS = ("uni", "bi", "both")
 TRANSPORTS = ("xla", "pallas_dma")
 PP_SCHEDULES = ("1f1b", "zb")
+TICK_LOWERINGS = ("masked", "switch")
+# Manual-executor tick lowerings (tpu_p2p/models/schedule.py lower()):
+# "masked" = the legacy masked-SPMD execution — every rank runs every
+# tick's full compute body and discards idle work through
+# where-masks (bitwise the pre-IR executors, the default); "switch" =
+# the cost-proportional lowering — each rank's tick body dispatches
+# through ONE lax.switch over the program's compact op table
+# (fwd / bwd / bwd_input / bwd_weight / no-op), so a rank whose tick
+# is idle pays only the branch select and the collective hop it
+# participates in. The two lowerings are BITWISE equal in value
+# (tests/test_schedule.py); switch is what lets the zero-bubble
+# schedule's analytic win cash out as wall clock
+# (docs/schedule_ir.md). ONE definition governs the CLI choices,
+# BenchConfig, and FlagshipConfig validation alike, like
+# PP_SCHEDULES.
 # Manual-executor pipeline tick schedules (tpu_p2p/models/schedule.py):
 # "1f1b" = the fused-backward 1F1B/interleaved program (the default —
 # bitwise the pre-IR executors); "zb" = the ZB-H1-style zero-bubble
@@ -212,6 +227,12 @@ class BenchConfig:
     # program — tpu_p2p/models/schedule.py compile_zb; "1f1b" keeps
     # the default GPipe-autodiff step). Mirrors
     # FlagshipConfig.pp_schedule; other patterns ignore it.
+    tick_lowering: str = "masked"  # flagship_step: tick lowering for
+    # the MANUAL executor's compiled programs ("switch" = the
+    # cost-proportional lax.switch dispatch — idle ranks genuinely
+    # idle; routes the workload through the IR executor even under
+    # pp_schedule="1f1b"). Mirrors FlagshipConfig.tick_lowering, one
+    # TICK_LOWERINGS definition; other patterns ignore it.
 
     def __post_init__(self) -> None:
         if self.pattern not in PATTERNS:
@@ -252,6 +273,11 @@ class BenchConfig:
             raise ValueError(
                 f"unknown pp_schedule {self.pp_schedule!r}; expected "
                 f"one of {PP_SCHEDULES}"
+            )
+        if self.tick_lowering not in TICK_LOWERINGS:
+            raise ValueError(
+                f"unknown tick_lowering {self.tick_lowering!r}; "
+                f"expected one of {TICK_LOWERINGS}"
             )
         if self.transport not in TRANSPORTS:
             raise ValueError(

@@ -138,6 +138,42 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
     axes = _mesh_axes(mesh)
     if "pp" not in axes:
         raise ValueError("mesh needs a 'pp' axis for pipeline parallelism")
+    if cfg.tick_lowering == "switch":
+        # The switch dispatch runs DIFFERENT branches on different pp
+        # ranks in the same tick, so the dispatched stage block must
+        # not issue permute-family collectives: a collective-permute
+        # (or all_to_all reshard) is ONE whole-mesh instruction whose
+        # rendezvous every device must reach — ranks executing another
+        # branch never arrive and the step deadlocks. Group-scoped
+        # reductions are safe (a tp psum's replica group sits at one
+        # pp rank, so its members always agree on the branch) — the
+        # tp x pp parity test pins that bitwise. The sp attention
+        # rings, MoE ep reshards, and the ring-overlap decompositions
+        # all ship permutes inside the block, so they stay on the
+        # masked lowering.
+        blockers = []
+        if axes.get("sp") and mesh.shape["sp"] > 1:
+            blockers.append(
+                "sp>1 (sequence-parallel attention ships "
+                "ppermutes/all_to_alls inside the block)")
+        if (axes.get("ep") and mesh.shape["ep"] > 1
+                and not cfg.dense_ffn):
+            blockers.append(
+                "MoE ep>1 (dispatch/combine reshards inside the "
+                "block)")
+        if (axes.get("tp") and mesh.shape["tp"] > 1
+                and cfg.tp_overlap == "ring"):
+            blockers.append(
+                "tp_overlap='ring' (collective-matmul ppermutes "
+                "inside the block)")
+        if blockers:
+            raise ValueError(
+                "tick_lowering='switch' needs a stage block free of "
+                "permute-family collectives (rank-divergent "
+                "lax.switch branches deadlock a whole-mesh "
+                "collective-permute rendezvous); keep "
+                "tick_lowering='masked' here: " + "; ".join(blockers)
+            )
     n = mesh.shape["pp"]
     if cfg.stages % (n * chunks):
         raise ValueError(
@@ -145,15 +181,26 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
             f"chunks ({chunks})"
         )
     s_chunk = cfg.stages // (n * chunks)
-    if cfg.pp_schedule == "zb":
-        # The zero-bubble tick program, executed by the unified IR
-        # executor (tpu_p2p/models/schedule.py): bitwise the fused
-        # "1f1b" step — per-stage dW accumulation order is preserved —
-        # with the backward split so weight-grad ticks fill the
-        # schedule's bubbles (docs/schedule_ir.md).
-        from tpu_p2p.models.schedule import compile_zb, lower
+    use_ir = cfg.pp_schedule == "zb" or cfg.tick_lowering != "masked"
+    if use_ir:
+        # The unified IR executor (tpu_p2p/models/schedule.py) owns
+        # both the zero-bubble program (bitwise the fused "1f1b" step
+        # — per-stage dW accumulation order is preserved — with the
+        # backward split so weight-grad ticks fill the schedule's
+        # bubbles) and the cost-proportional tick_lowering="switch"
+        # dispatch (bitwise the masked execution, idle ranks
+        # genuinely idle) — so a switch-lowered "1f1b" run compiles
+        # the fused program through the same IR (docs/schedule_ir.md).
+        from tpu_p2p.models.schedule import (
+            compile_interleaved,
+            compile_zb,
+            lower,
+        )
 
-        lowered = lower(compile_zb(cfg.microbatches, n))
+        prog = (compile_zb(cfg.microbatches, n)
+                if cfg.pp_schedule == "zb"
+                else compile_interleaved(cfg.microbatches, n, chunks))
+        lowered = lower(prog, tick_lowering=cfg.tick_lowering)
     else:
         sched = build_interleaved_schedule(cfg.microbatches, n, chunks)
     sp, tp, ep = axes.get("sp"), axes.get("tp"), axes.get("ep")
@@ -195,7 +242,7 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
         mb = b_loc // cfg.microbatches
         x_mb = x.reshape((cfg.microbatches, mb) + x.shape[1:])
         t_mb = target.reshape((cfg.microbatches, mb) + target.shape[1:])
-        if cfg.pp_schedule == "zb":
+        if use_ir:
             from tpu_p2p.models.schedule import tick_grads_local
 
             loss_sum, grads = tick_grads_local(

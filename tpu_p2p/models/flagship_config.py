@@ -137,6 +137,29 @@ class FlagshipConfig:
     # GPipe-autodiff steps (make_flagship_train_step / the LM/optax
     # steps) reject "zb" — autodiff owns their backward, so a zb
     # label there would silently time the baseline.
+    tick_lowering: str = "masked"  # tick lowering for the MANUAL
+    # executor's compiled programs (make_flagship_train_step_1f1b):
+    # "masked" — the legacy masked-SPMD execution: every rank runs
+    # every tick's full compute body, idle work discarded through
+    # where-masks (bitwise the pre-IR executors; pp_schedule="1f1b"
+    # then runs the legacy interleaved executor directly). "switch" —
+    # the cost-proportional lowering (tpu_p2p/models/schedule.py):
+    # the program compiles to per-rank tick timelines and each rank's
+    # tick body dispatches through ONE lax.switch over the compact op
+    # table, so an idle rank pays only the branch select and the hop
+    # it participates in; the step stays BITWISE vs "masked" — wall
+    # clock finally tracks the schedule's analytic bubble
+    # (docs/schedule_ir.md). Routes pp_schedule="1f1b" through the
+    # compiled IR program too (bitwise the legacy executor).
+    # Constraint: the dispatched stage block must be free of
+    # permute-family collectives (rank-divergent branches deadlock a
+    # whole-mesh collective-permute rendezvous), so the manual
+    # executor rejects "switch" on sp>1 / MoE-ep>1 / ring-overlap
+    # meshes; tp psum joins and dp/ep data sharding are safe (group-
+    # scoped, branch-uniform — pinned bitwise). The GPipe-autodiff
+    # steps reject "switch" — their schedule is a masked scan
+    # autodiff owns, and a switch label there would silently time
+    # the baseline.
     use_flash: bool = False  # Pallas flash kernel for the attention
     # math, trainable under every sp_strategy: Ulysses sees the full
     # sequence locally (the standalone custom-vjp kernel drops in);
@@ -252,6 +275,16 @@ class FlagshipConfig:
             raise ValueError(
                 f"unknown pp_schedule {self.pp_schedule!r}; expected "
                 f"one of {PP_SCHEDULES}"
+            )
+        # Strict like pp_schedule, ONE definition with config.py/cli:
+        # a typo ("Switch", "select") would silently run the masked
+        # execution while the run's logs claim cost-proportional.
+        from tpu_p2p.config import TICK_LOWERINGS
+
+        if self.tick_lowering not in TICK_LOWERINGS:
+            raise ValueError(
+                f"unknown tick_lowering {self.tick_lowering!r}; "
+                f"expected one of {TICK_LOWERINGS}"
             )
         # Strict: a typo'd policy name must fail at config time, not
         # trace deep inside the step builder. hasattr alone is not

@@ -49,12 +49,23 @@ def _reject_zb_schedule(cfg: FlagshipConfig) -> None:
     baseline while its logs claim zero-bubble (the strict-knob class
     every overlap validation guards). The manual executor
     (:func:`tpu_p2p.models.flagship_1f1b.make_flagship_train_step_1f1b`)
-    honors the knob."""
+    honors the knob. ``tick_lowering="switch"`` is rejected for the
+    same reason: the cost-proportional dispatch is a property of the
+    IR executor's tick tables — the GPipe scan here is a masked
+    schedule autodiff owns, and a switch label on it would silently
+    time the masked baseline."""
     if cfg.pp_schedule == "zb":
         raise ValueError(
             "pp_schedule='zb' requires the manual 1F1B executor "
             "(make_flagship_train_step_1f1b); the GPipe autodiff "
             "steps have no backward ticks to split"
+        )
+    if cfg.tick_lowering != "masked":
+        raise ValueError(
+            f"tick_lowering={cfg.tick_lowering!r} requires the manual "
+            "1F1B executor (make_flagship_train_step_1f1b); the GPipe "
+            "autodiff steps run a masked scan with no per-rank tick "
+            "timeline to dispatch over"
         )
 
 

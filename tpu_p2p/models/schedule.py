@@ -60,6 +60,30 @@ the arithmetic the fused vjp computes, just partitioned; no sum is
 reassociated because each stage's dW terms still accumulate in
 microbatch order and the loss terms still accumulate at the last
 stage's ``bwd_input`` ticks in microbatch order.
+
+**Cost-proportional tick lowering (round 16).** The masked-SPMD
+execution above runs EVERY tick's full compute body on EVERY rank
+and discards idle work through where-masks — wall clock tracks
+``ticks x full-body cost``, so the analytic bubble win never cashed
+out as measured step time (bench nulled the pp>1 measured pair with
+exactly that reason). :func:`lower` now takes
+``tick_lowering="masked"|"switch"`` (one
+:data:`tpu_p2p.config.TICK_LOWERINGS` definition): ``"switch"``
+compiles the program into per-rank tick timelines — an ``op_code``
+table ``[T, devices]`` indexing a compact per-program op table
+(``noop`` plus whichever of ``fwd``/``bwd``/``bwd_input``/
+``bwd_weight`` the program issues) — and the executors dispatch each
+rank's tick body through ONE ``jax.lax.switch`` over that table, so
+a rank whose tick is idle pays only the branch select, the stash
+bookkeeping, and the collective hop it participates in (hops stay
+outside the switch: every rank must join the ``ppermute`` every
+tick). The branch bodies are the masked bodies minus the masks —
+same primitives, same operands, same accumulation order — so the
+two lowerings are BITWISE equal in value on every parity mesh, and
+every compiled schedule (zb today, ZB-V/interleaved variants
+tomorrow) inherits the cost-proportional wall clock for free
+(docs/schedule_ir.md has the dispatch anatomy and when masked still
+wins).
 """
 
 from __future__ import annotations
@@ -69,9 +93,18 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from tpu_p2p.config import TICK_LOWERINGS
 from tpu_p2p.obs import ledger as _ledger
 
 Edge = Tuple[int, int]
+
+# Canonical kind order of the compact switch op table: index 0 is
+# always "noop"; a program's table then carries, in this order, only
+# the kinds it actually issues — so a zb program dispatches over
+# (noop, fwd, bwd_input, bwd_weight) and a fused program over
+# (noop, fwd, bwd), and lax.switch never traces a branch the program
+# cannot take.
+_SWITCH_KIND_ORDER = ("fwd", "bwd", "bwd_input", "bwd_weight")
 
 # Analytic op costs in forward-units: the fused backward computes both
 # dx and dW against a rematerialized forward (~2x the forward's
@@ -168,6 +201,43 @@ def bubble_fraction(program: TickProgram) -> float:
     return 1.0 - sum(busy) / (n * span)
 
 
+def per_rank_idle(program: TickProgram) -> List[dict]:
+    """Per-rank idle accounting under :data:`OP_COST` — the rank-level
+    decomposition of :func:`bubble_fraction`: for each device, its
+    busy/idle cost split, its own bubble fraction, and its explicit
+    ``idle_spans`` — maximal ``[start_tick, end_tick)`` runs of ticks
+    where the rank issues no compute op. Under the masked lowering
+    those spans are where-masked full bodies (the rank still pays
+    them); under the switch lowering they are genuinely idle — which
+    is exactly what ``python -m tpu_p2p obs`` renders them to show
+    (measured-vs-analytic bubble per rank)."""
+    n = program.devices
+    tick_cost = [max((OP_COST[op.kind] for op in t.compute),
+                     default=1.0) for t in program.ticks]
+    span = sum(tick_cost)
+    out: List[dict] = []
+    for d in range(n):
+        busy = 0.0
+        spans: List[List[int]] = []
+        for t, tick in enumerate(program.ticks):
+            ops = [op for op in tick.compute if op.device == d]
+            if ops:
+                busy += sum(OP_COST[op.kind] for op in ops)
+            elif spans and spans[-1][1] == t:
+                spans[-1][1] = t + 1
+            else:
+                spans.append([t, t + 1])
+        idle = max(span - busy, 0.0)
+        out.append({
+            "device": d,
+            "busy_cost": busy,
+            "idle_cost": idle,
+            "bubble_frac": (idle / span) if span > 0 else 0.0,
+            "idle_spans": [tuple(s) for s in spans],
+        })
+    return out
+
+
 def price_program(program: TickProgram, payload_bytes: int) -> dict:
     """Analytic transport bill of one program execution, priced with
     the collective ledger's busbw conventions
@@ -176,7 +246,11 @@ def price_program(program: TickProgram, payload_bytes: int) -> dict:
     ``python -m tpu_p2p obs`` prints for a *measured* run. ``gradient``
     hops carry float32 cotangents; callers pass the per-payload byte
     count they care about (the executors ship one microbatch shard per
-    hop)."""
+    hop). ``per_rank`` prices each rank's idle ticks explicitly
+    (:func:`per_rank_idle`) — the bubble decomposed to the device
+    whose wall clock it is, which is what the cost-proportional
+    switch lowering turns from an accounting fiction into genuinely
+    idle time."""
     rows: List[dict] = []
     total_wire = 0
     for i, tick in enumerate(program.ticks):
@@ -196,6 +270,7 @@ def price_program(program: TickProgram, payload_bytes: int) -> dict:
         "hops": len(rows),
         "wire_bytes_total": total_wire,
         "bubble_frac": bubble_fraction(program),
+        "per_rank": per_rank_idle(program),
         "rows": rows,
     }
 
@@ -406,7 +481,16 @@ class LoweredProgram:
     slot counts — the exact table family the legacy interleaved
     executor runs, extended with ``w_*`` tables for split-backward
     programs. Forward-only programs carry just the feed/record
-    tables."""
+    tables.
+
+    ``lowering`` names how the executor runs the tables:
+    ``"masked"`` = every rank traces every tick body, idle work
+    where-masked (the legacy execution); ``"switch"`` = per-rank tick
+    timelines — ``tables["op_code"]`` indexes ``op_table`` (a compact
+    per-program kind tuple, ``op_table[0] == "noop"`` always) and the
+    tick body is ONE ``lax.switch`` over it. Both lowerings execute
+    the same ops on the same operands in the same order, so the step
+    is bitwise identical; only what idle ranks pay differs."""
 
     program: TickProgram
     forward_only: bool
@@ -416,6 +500,8 @@ class LoweredProgram:
     fwd_edges: Tuple[Edge, ...]
     bwd_edges: Tuple[Edge, ...]
     tables: Dict[str, np.ndarray]
+    lowering: str = "masked"
+    op_table: Tuple[str, ...] = ("noop",)
 
 
 def _op_ticks(program: TickProgram):
@@ -440,7 +526,33 @@ def _op_ticks(program: TickProgram):
     return fwd, bwd, wgt
 
 
-def lower(program: TickProgram) -> LoweredProgram:
+def _switch_tables(program: TickProgram):
+    """→ ``(op_table, op_code [T, devices])`` for the switch lowering:
+    the compact per-program kind tuple (``noop`` first, then the
+    kinds the program issues in :data:`_SWITCH_KIND_ORDER`) and the
+    per-rank tick timeline indexing it. The one-op-per-device-per-tick
+    discipline every compiler keeps is what makes a single branch
+    index per (tick, rank) sufficient — a program violating it cannot
+    lower to switch and fails loudly here."""
+    kinds = {op.kind for t in program.ticks for op in t.compute}
+    op_table = ("noop",) + tuple(k for k in _SWITCH_KIND_ORDER
+                                 if k in kinds)
+    code_of = {k: i for i, k in enumerate(op_table)}
+    op_code = np.zeros((program.num_ticks, program.devices), np.int32)
+    for t, tick in enumerate(program.ticks):
+        for op in tick.compute:
+            if op_code[t, op.device] != 0:
+                raise ValueError(
+                    f"{program.name}: device {op.device} has more "
+                    f"than one compute op at tick {t} — the switch "
+                    "lowering dispatches one branch per rank per tick"
+                )
+            op_code[t, op.device] = code_of[op.kind]
+    return op_table, op_code
+
+
+def lower(program: TickProgram,
+          tick_lowering: str = "masked") -> LoweredProgram:
     """Lower an IR program to executor tables.
 
     Stash slots are interval-colored per device with the SAME
@@ -453,9 +565,19 @@ def lower(program: TickProgram) -> LoweredProgram:
     read and the incoming gradient is re-read there too (the last
     virtual stage's loss gradient is written into the gradient stash
     at its ``bwd_input`` tick, so the ``bwd_weight`` tick reads every
-    stage's cotangent the same way)."""
+    stage's cotangent the same way).
+
+    ``tick_lowering="switch"`` additionally emits the per-rank
+    ``op_code`` timeline over the program's compact ``op_table`` (see
+    :class:`LoweredProgram`); ``"masked"`` keeps the legacy tables
+    byte-identical to round 14's."""
     from tpu_p2p.models.pipeline_1f1b import _color_intervals
 
+    if tick_lowering not in TICK_LOWERINGS:
+        raise ValueError(
+            f"unknown tick_lowering {tick_lowering!r}; expected one "
+            f"of {TICK_LOWERINGS}"
+        )
     n, v, m = program.devices, program.chunks, program.microbatches
     s_virt = n * v
     T = program.num_ticks
@@ -465,19 +587,33 @@ def lower(program: TickProgram) -> LoweredProgram:
                       if h.payload == "gradient"), ())
     fwd_tick, bwd_tick, w_tick = _op_ticks(program)
 
+    op_table: Tuple[str, ...] = ("noop",)
+    op_code = None
+    if tick_lowering == "switch":
+        op_table, op_code = _switch_tables(program)
+
     if not program.has_backward:
         if (fwd_tick < 0).any():
             raise ValueError(f"{program.name}: forward ops missing")
+        if tick_lowering == "switch" and v != 1:
+            raise ValueError(
+                f"{program.name}: the switch lowering of forward-only "
+                "programs supports chunks=1 only (no chunked "
+                "forward-only compiler exists)"
+            )
         feed_mb = np.full((T,), -1, np.int32)
         out_mb = np.full((T,), -1, np.int32)
         for mb in range(m):
             feed_mb[fwd_tick[0, mb]] = mb
             out_mb[fwd_tick[s_virt - 1, mb]] = mb
+        tables = {"feed_mb": feed_mb, "out_mb": out_mb}
+        if op_code is not None:
+            tables["op_code"] = op_code
         return LoweredProgram(
             program=program, forward_only=True, split=False,
             act_slots=0, grad_slots=0,
             fwd_edges=tuple(fwd_edges), bwd_edges=(),
-            tables={"feed_mb": feed_mb, "out_mb": out_mb},
+            tables=tables, lowering=tick_lowering, op_table=op_table,
         )
 
     split = program.has_split_backward
@@ -550,11 +686,13 @@ def lower(program: TickProgram) -> LoweredProgram:
                 tables["w_cidx"][w_tick[sv, mb], d] = c
                 tables["w_slot"][w_tick[sv, mb], d] = slot
                 tables["w_gslot"][w_tick[sv, mb], d] = gs
+    if op_code is not None:
+        tables["op_code"] = op_code
     return LoweredProgram(
         program=program, forward_only=False, split=split,
         act_slots=act_slots, grad_slots=grad_slots,
         fwd_edges=tuple(fwd_edges), bwd_edges=tuple(bwd_edges),
-        tables=tables,
+        tables=tables, lowering=tick_lowering, op_table=op_table,
     )
 
 
@@ -589,7 +727,14 @@ def tick_forward_local(block_fn: Callable, params_local, x_mb,
     feed/record indices read from the lowered tables instead of
     recomputed from the tick counter — so the executed values are
     bitwise the legacy scan's. Differentiable end to end (the GPipe
-    backward contract)."""
+    backward contract).
+
+    Under the switch lowering each rank dispatches its tick through
+    ``lax.switch`` over the (noop, fwd) op table: idle ranks skip the
+    block entirely and ship zeros. Recorded outputs only ever read
+    active ticks (a schedule property), and idle-tick cotangents are
+    exact zeros under the masked lowering, so values AND autodiff
+    gradients stay bitwise the masked scan's."""
     import jax
     import jax.numpy as jnp
 
@@ -600,11 +745,14 @@ def tick_forward_local(block_fn: Callable, params_local, x_mb,
     m = x_mb.shape[0]
     wave = pp_overlap == "wave" and pp_chunks > 1 and n > 1
     edges = lowered.fwd_edges
+    switch = lowered.lowering == "switch"
     zero = jax.lax.pcast(jnp.zeros_like(x_mb[0]), (axis,),
                          to="varying")
 
-    def tick(carry, row):
-        prev_in, outputs = carry
+    def tick_body(prev_in, outputs, row):
+        """One rank's active fwd tick — shared verbatim between the
+        masked tick (which always runs it) and the switch fwd branch
+        (which runs it only when this rank's op_code says fwd)."""
         feed_t = row["feed_mb"]
         mb_idx = jnp.clip(feed_t, 0, m - 1)
         feed = jnp.where(
@@ -615,16 +763,31 @@ def tick_forward_local(block_fn: Callable, params_local, x_mb,
         )
         x_in = jnp.where(my == 0, feed, prev_in)
         y = block_fn(params_local, x_in)
-        if n > 1:
-            y_next = _ship(y, axis, edges, wave, pp_chunks, transport,
-                           label="pp_stage_ship")
-        else:
-            y_next = zero
         rec_t = row["out_mb"]
         upd = jax.lax.dynamic_update_index_in_dim(
             outputs, y, jnp.clip(rec_t, 0, m - 1), 0
         )
         outputs = jnp.where((my == n - 1) & (rec_t >= 0), upd, outputs)
+        return y, outputs
+
+    def tick(carry, row):
+        prev_in, outputs = carry
+        if switch:
+            code = jax.lax.dynamic_index_in_dim(
+                row["op_code"], my, 0, keepdims=False)
+            y, outputs = jax.lax.switch(
+                code,
+                [lambda p, o: (zero, o),        # noop
+                 lambda p, o: tick_body(p, o, row)],  # fwd
+                prev_in, outputs,
+            )
+        else:
+            y, outputs = tick_body(prev_in, outputs, row)
+        if n > 1:
+            y_next = _ship(y, axis, edges, wave, pp_chunks, transport,
+                           label="pp_stage_ship")
+        else:
+            y_next = zero
         return (y_next, outputs), None
 
     outputs0 = jax.lax.pcast(jnp.zeros_like(x_mb), (axis,),
@@ -659,6 +822,17 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
       ticks (forward rematerialized from the still-stashed
       activation), accumulating each stage's dW in microbatch order —
       bitwise the fused step, per the module docstring.
+
+    Under ``lowered.lowering == "switch"`` the tick body dispatches
+    through ONE ``lax.switch`` over the program's compact op table
+    instead of running every masked body: the branch bodies are the
+    masked bodies minus the masks (same primitives, same operands,
+    same per-stage accumulation order — bitwise the masked lowering),
+    stash receives and the two collective hops stay outside the
+    switch (every rank joins every tick's ``ppermute``), and an idle
+    rank's tick costs the branch select plus the hop — the
+    cost-proportional execution the analytic bubble model assumes
+    (module docstring, docs/schedule_ir.md).
 
     Returns ``(loss_sum replicated over axis, dparams_local)`` — the
     legacy executor's exact contract (same ``vma_axes`` /
@@ -710,9 +884,10 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
             params,
         )
 
-    def tick(carry, row):
-        x_stash, g_stash, y_recv, g_recv, dparams, loss_acc = carry
-
+    def stash_recv(x_stash, g_stash, y_recv, g_recv, row):
+        """Write the tick's arrivals into their stash slots — shared
+        verbatim by BOTH lowerings (receives are mask-gated in each:
+        whether a rank receives is a schedule property, not an op)."""
         rs = pick(row["recv_slot"])
         x_stash = jnp.where(
             rs >= 0,
@@ -731,6 +906,21 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
             ),
             g_stash,
         )
+        return x_stash, g_stash
+
+    def accum_slice(acc, dc, start):
+        """Accumulate one param-chunk cotangent into its rows —
+        the ONE gradient-accumulate both lowerings run (masked gates
+        it with a where; a switch branch runs it only when on)."""
+        cur = jax.lax.dynamic_slice_in_dim(acc, start, chunk_rows, 0)
+        return jax.lax.dynamic_update_slice_in_dim(
+            acc, cur + dc.astype(jnp.float32), start, 0
+        )
+
+    def tick(carry, row):
+        x_stash, g_stash, y_recv, g_recv, dparams, loss_acc = carry
+        x_stash, g_stash = stash_recv(x_stash, g_stash, y_recv,
+                                      g_recv, row)
 
         # Backward (fused) / backward-input (split): remat the chunk's
         # forward under vjp — against both (params, x) when fused,
@@ -777,12 +967,7 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
         b_start = jnp.clip(b_cidx, 0, v - 1) * chunk_rows
 
         def accum_at(acc, dc, start, on):
-            cur = jax.lax.dynamic_slice_in_dim(acc, start, chunk_rows,
-                                               0)
-            upd = jax.lax.dynamic_update_slice_in_dim(
-                acc, cur + dc.astype(jnp.float32), start, 0
-            )
-            return jnp.where(on, upd, acc)
+            return jnp.where(on, accum_slice(acc, dc, start), acc)
 
         if not split:
             dparams = jax.tree.map(
@@ -855,12 +1040,149 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
         return (x_stash, g_stash, y_next, g_next, dparams,
                 loss_acc), None
 
+    # Cost-proportional tick: ONE lax.switch over the program's
+    # compact op table. Every branch body below is its masked twin
+    # above minus the where-masks — a branch only ever runs when its
+    # mask would have been True, so values (and therefore the step)
+    # are bitwise the masked lowering's. Stash receives stay before
+    # the switch and the hops after it: collectives cannot live
+    # inside a rank-divergent branch.
+    zero_g = varying(jnp.zeros(mb_shape, jnp.float32))
+
+    def tick_switch(carry, row):
+        x_stash, g_stash, y_recv, g_recv, dparams, loss_acc = carry
+        x_stash, g_stash = stash_recv(x_stash, g_stash, y_recv,
+                                      g_recv, row)
+
+        def bwd_front(x_s, g_s):
+            """The shared head of both backward kinds: stash read,
+            remat operands, target, incoming cotangent — verbatim the
+            masked body's lines."""
+            b_mb = pick(row["b_mb"])
+            b_cidx = pick(row["b_cidx"])
+            x_saved = jax.lax.dynamic_index_in_dim(
+                x_s,
+                jnp.clip(pick(row["b_slot"]), 0,
+                         lowered.act_slots - 1),
+                0, keepdims=False,
+            )
+            chunk_b = chunk_of(params_local, b_cidx)
+            tgt = jax.lax.dynamic_index_in_dim(
+                target_mb, jnp.clip(b_mb, 0, m - 1), 0,
+                keepdims=False,
+            )
+            b_gslot = jnp.clip(pick(row["b_gslot"]), 0,
+                               lowered.grad_slots - 1)
+            g_mid = jax.lax.dynamic_index_in_dim(g_s, b_gslot, 0,
+                                                 keepdims=False)
+            is_last = (my == n - 1) & (b_cidx == v - 1)
+            return (b_cidx, x_saved, chunk_b, tgt, b_gslot, g_mid,
+                    is_last)
+
+        def br_noop(x_s, g_s, dp, la):
+            return x_s, g_s, dp, la, zero_mb, zero_g
+
+        def br_fwd(x_s, g_s, dp, la):
+            f_mb = pick(row["f_mb"])
+            f_cidx = pick(row["f_cidx"])
+            f_slot = jnp.clip(pick(row["f_slot"]), 0,
+                              lowered.act_slots - 1)
+            feed = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(f_mb, 0, m - 1), 0, keepdims=False,
+            )
+            x_in = jnp.where((my == 0) & (f_cidx == 0), feed,
+                             jax.lax.dynamic_index_in_dim(
+                                 x_s, f_slot, 0, keepdims=False))
+            x_s = jax.lax.dynamic_update_index_in_dim(x_s, x_in,
+                                                      f_slot, 0)
+            y_f = block_fn(chunk_of(params_local, f_cidx), x_in)
+            return x_s, g_s, dp, la, y_f, zero_g
+
+        def br_bwd(x_s, g_s, dp, la):
+            (b_cidx, x_saved, chunk_b, tgt, _b_gslot, g_mid,
+             is_last) = bwd_front(x_s, g_s)
+            y_re, vjp = jax.vjp(block_fn, chunk_b, x_saved)
+            loss_mb, g_loss = loss_grad_fn(y_re, tgt)
+            g_in = jnp.where(is_last, g_loss, g_mid)
+            dchunk, dx = vjp(g_in.astype(y_re.dtype))
+            b_start = jnp.clip(b_cidx, 0, v - 1) * chunk_rows
+            dp = jax.tree.map(
+                lambda acc, dc: accum_slice(acc, dc, b_start),
+                dp, dchunk,
+            )
+            la = la + jnp.where(is_last, loss_mb.astype(jnp.float32),
+                                0.0)
+            return x_s, g_s, dp, la, zero_mb, dx.astype(jnp.float32)
+
+        def br_bwd_input(x_s, g_s, dp, la):
+            (_b_cidx, x_saved, chunk_b, tgt, b_gslot, g_mid,
+             is_last) = bwd_front(x_s, g_s)
+            y_re, vjp_x = jax.vjp(lambda xx: block_fn(chunk_b, xx),
+                                  x_saved)
+            loss_mb, g_loss = loss_grad_fn(y_re, tgt)
+            g_in = jnp.where(is_last, g_loss, g_mid)
+            # Stash the cotangent actually consumed for the deferred
+            # bwd_weight re-read (masked twin: the b_on'd rewrite).
+            g_s = jax.lax.dynamic_update_index_in_dim(
+                g_s, g_in.astype(jnp.float32), b_gslot, 0
+            )
+            (dx,) = vjp_x(g_in.astype(y_re.dtype))
+            la = la + jnp.where(is_last, loss_mb.astype(jnp.float32),
+                                0.0)
+            return x_s, g_s, dp, la, zero_mb, dx.astype(jnp.float32)
+
+        def br_bwd_weight(x_s, g_s, dp, la):
+            w_cidx = pick(row["w_cidx"])
+            x_w = jax.lax.dynamic_index_in_dim(
+                x_s,
+                jnp.clip(pick(row["w_slot"]), 0,
+                         lowered.act_slots - 1),
+                0, keepdims=False,
+            )
+            g_w = jax.lax.dynamic_index_in_dim(
+                g_s,
+                jnp.clip(pick(row["w_gslot"]), 0,
+                         lowered.grad_slots - 1),
+                0, keepdims=False,
+            )
+            chunk_w = chunk_of(params_local, w_cidx)
+            y_w, vjp_p = jax.vjp(lambda pp: block_fn(pp, x_w),
+                                 chunk_w)
+            (dchunk_w,) = vjp_p(g_w.astype(y_w.dtype))
+            w_start = jnp.clip(w_cidx, 0, v - 1) * chunk_rows
+            dp = jax.tree.map(
+                lambda acc, dc: accum_slice(acc, dc, w_start),
+                dp, dchunk_w,
+            )
+            return x_s, g_s, dp, la, zero_mb, zero_g
+
+        branch_of = {"noop": br_noop, "fwd": br_fwd, "bwd": br_bwd,
+                     "bwd_input": br_bwd_input,
+                     "bwd_weight": br_bwd_weight}
+        code = pick(row["op_code"])
+        (x_stash, g_stash, dparams, loss_acc, y_f, dx) = \
+            jax.lax.switch(
+                code, [branch_of[k] for k in lowered.op_table],
+                x_stash, g_stash, dparams, loss_acc,
+            )
+
+        if n > 1:
+            y_next = _ship(y_f, axis, lowered.fwd_edges, wave,
+                           pp_chunks, transport, label="pp_fwd_ship")
+            g_next = _ship(dx, axis, lowered.bwd_edges, wave,
+                           pp_chunks, transport, label="pp_bwd_ship")
+        else:
+            y_next, g_next = y_f, dx
+        return (x_stash, g_stash, y_next, g_next, dparams,
+                loss_acc), None
+
     carry0 = (x_stash0, g_stash0, zero_mb,
               varying(jnp.zeros(mb_shape, jnp.float32)), dparams0,
               varying(jnp.zeros((), jnp.float32)))
     rows = {k: jnp.asarray(v) for k, v in lowered.tables.items()}
     (_, _, _, _, dparams, loss_acc), _ = jax.lax.scan(
-        tick, carry0, rows
+        tick_switch if lowered.lowering == "switch" else tick,
+        carry0, rows,
     )
     return C.psum(loss_acc, axis, label="pp_loss_replicate"), dparams
 
@@ -870,7 +1192,8 @@ def make_tick_train_step(mesh, cfg, program: TickProgram,
                          lr: float = 1e-2,
                          loss_grad_fn: Optional[Callable] = None,
                          pp_overlap: str = "none", pp_chunks: int = 1,
-                         transport: str = "xla"):
+                         transport: str = "xla",
+                         tick_lowering: str = "masked"):
     """ONE jitted SGD step for ANY tick program — the executor every
     schedule compiles to.
 
@@ -888,7 +1211,10 @@ def make_tick_train_step(mesh, cfg, program: TickProgram,
     :func:`~tpu_p2p.models.pipeline_interleaved.
     place_interleaved_params`). ``pp_overlap``/``pp_chunks``/
     ``transport`` lower every stage hop per tick through
-    ``chunked_ppermute_compute`` — the one ship site."""
+    ``chunked_ppermute_compute`` — the one ship site;
+    ``tick_lowering="switch"`` runs the cost-proportional per-rank
+    dispatch (bitwise the default masked execution, idle ranks
+    genuinely idle — module docstring)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -921,7 +1247,7 @@ def make_tick_train_step(mesh, cfg, program: TickProgram,
             f"cfg.microbatches ({cfg.microbatches}) != program "
             f"microbatches ({program.microbatches})"
         )
-    lowered = lower(program)
+    lowered = lower(program, tick_lowering=tick_lowering)
 
     if lowered.forward_only:
         def step(params, x, target):

@@ -47,7 +47,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Tolerance", "TOLERANCES", "headline_from_artifact",
            "load_trajectory", "load_multichip_history", "compare",
-           "write_multichip_artifact", "main"]
+           "write_multichip_artifact", "print_schedule_bubbles",
+           "main"]
 
 
 @dataclass(frozen=True)
@@ -416,6 +417,69 @@ def load_multichip_history(artifacts_dir: str = "."):
     return best
 
 
+def print_schedule_bubbles(n: int, cur_head: Optional[dict] = None,
+                           microbatches: int = 2,
+                           stream=None) -> None:
+    """Render the measured-vs-analytic pipeline bubble per rank.
+
+    Analytic side: :func:`tpu_p2p.models.schedule.price_program`'s
+    per-rank ``idle`` spans (the round-16 satellite) for the fused
+    1F1B and zero-bubble programs at the live-capture shape (M=2,
+    S=n — the same tiny tick-IR step :func:`ledger.live_capture`
+    prices), plus the span ratio a cost-proportional execution of
+    the two schedules would show. Measured side: the
+    ``pp_step_ms_sched_{1f1b,zb}`` pair from the gated bench
+    artifact when it carries one — reported with its arms NAMED (the
+    zb route under the switch lowering vs the fused production step
+    under its masked legacy executor, at bench's own shape), because
+    the pair deliberately compares the shipped routes, not the
+    schedules under one lowering — so it is context next to the
+    analytic ratio, not its executed twin (docs/schedule_ir.md,
+    "what the bench pair grades").
+    """
+    out = stream if stream is not None else sys.stdout
+    from tpu_p2p.models import schedule as SCH
+
+    progs = [SCH.compile_1f1b(microbatches, n),
+             SCH.compile_zb(microbatches, n)]
+    spans = {}
+    out.write(f"# schedule bubble per rank (tick IR @ M={microbatches}"
+              f" S={n}; analytic idle share under the IR cost model)\n")
+    for prog in progs:
+        bill = SCH.price_program(prog, payload_bytes=1024)
+        fracs = " ".join(f"{r['bubble_frac']:.2f}"
+                         for r in bill["per_rank"])
+        idle_ticks = sum(e - s for r in bill["per_rank"]
+                         for s, e in r["idle_spans"])
+        # Every rank's busy+idle is the program span (pinned by
+        # test_price_program_per_rank_idle_spans) — ONE cost-model
+        # source of truth, no hand-rolled twin here.
+        rank0 = bill["per_rank"][0]
+        spans[prog.name] = rank0["busy_cost"] + rank0["idle_cost"]
+        out.write(f"#   {prog.name:<5}: {fracs}  (program "
+                  f"{bill['bubble_frac']:.2f}, {idle_ticks} idle "
+                  f"rank-ticks over {bill['ticks']} ticks)\n")
+    ratio = spans["zb"] / spans["1f1b"] if spans.get("1f1b") else None
+    out.write(
+        f"#   analytic span ratio zb/1f1b: {ratio:.2f} under "
+        "cost-proportional execution\n"
+    )
+    head = cur_head or {}
+    ms_1 = head.get("pp_step_ms_sched_1f1b")
+    ms_z = head.get("pp_step_ms_sched_zb")
+    if ms_1 and ms_z:
+        out.write(
+            f"#   measured bench pair: zb route (switch lowering) "
+            f"{ms_z} ms vs fused production step (masked) {ms_1} ms\n"
+        )
+    else:
+        out.write(
+            "#   measured bench pair: n/a (current artifact carries "
+            "no pp_step_ms_sched pair)\n"
+        )
+    out.flush()
+
+
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tpu_p2p obs",
@@ -492,6 +556,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 if written:
                     print(f"# wrote {os.path.basename(written)} "
                           "(per-link achieved-Gbps matrix artifact)")
+                # Per-rank measured-vs-analytic pipeline bubble
+                # (round 16): the analytic side from price_program's
+                # idle spans, the measured side from the gated
+                # artifact's pp_step_ms_sched pair when present.
+                cur_head = None
+                try:
+                    _, cur_head, _ = load_trajectory(
+                        args.artifacts_dir, args.current)
+                except Exception:  # noqa: BLE001 — the bubble block
+                    # must not take the live report down when no
+                    # trajectory exists (fresh checkout).
+                    pass
+                print_schedule_bubbles(n, cur_head)
         rc = 0
         if not args.no_gate:
             cur_name, cur_head, priors = load_trajectory(

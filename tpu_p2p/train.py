@@ -672,7 +672,7 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pp-chunks", type=int, default=4,
                    help="token chunks per wave stage hop "
                         "(--pp-overlap wave)")
-    from tpu_p2p.config import PP_SCHEDULES
+    from tpu_p2p.config import PP_SCHEDULES, TICK_LOWERINGS
 
     p.add_argument("--pp-schedule", default="1f1b",
                    choices=PP_SCHEDULES,
@@ -682,6 +682,14 @@ def _build_parser() -> argparse.ArgumentParser:
                         "rejects it with a pointer at "
                         "make_flagship_train_step_1f1b / the "
                         "flagship_step workload)")
+    p.add_argument("--tick-lowering", default="masked",
+                   choices=TICK_LOWERINGS,
+                   help="tick lowering for compiled pipeline "
+                        "programs (switch = cost-proportional "
+                        "per-rank dispatch, manual-executor only — "
+                        "the training loop runs GPipe autodiff and "
+                        "rejects it with the same pointer as "
+                        "--pp-schedule zb)")
     return p
 
 
@@ -714,6 +722,7 @@ def main(argv=None) -> int:
         tp_overlap=args.tp_overlap, ep_overlap=args.ep_overlap,
         pp_overlap=args.pp_overlap, pp_chunks=args.pp_chunks,
         pp_schedule=args.pp_schedule,
+        tick_lowering=args.tick_lowering,
     )
     fault_plan = None
     if (args.fault_degrade_edge or args.fault_slow_rank is not None

@@ -30,6 +30,27 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
 
     rt, cfg = ctx.rt, ctx.cfg
     mesh = F.build_mesh(rt.num_devices, devices=list(rt.devices))
+    if model_cfg is None and cfg.tick_lowering != "masked":
+        # The switch dispatch forbids permute-family collectives
+        # inside the dispatched stage block (rank-divergent lax.switch
+        # branches deadlock a whole-mesh collective-permute rendezvous
+        # — make_flagship_train_step_1f1b rejects such meshes), so
+        # the workload lands the block-internal axes (sp/tp/ep) on dp
+        # instead: every device stays in the mesh, the pp axis keeps
+        # build_mesh's factor, and the printed line's mesh axes make
+        # the refactoring visible.
+        import numpy as np
+        from jax.sharding import Mesh
+
+        ax = dict(zip(mesh.axis_names, mesh.devices.shape))
+        pp = ax.get("pp", 1)
+        safe = tuple(
+            (rt.num_devices // pp) if a == "dp"
+            else (pp if a == "pp" else 1)
+            for a in mesh.axis_names
+        )
+        mesh = Mesh(np.asarray(mesh.devices).reshape(safe),
+                    mesh.axis_names)
     mc = model_cfg or F.FlagshipConfig().tiny(mesh)
     # sp_strategy is validated by FlagshipConfig.__post_init__.
     if model_cfg is None and cfg.dtype in ("bfloat16", "float32"):
@@ -61,11 +82,18 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
         # the MANUAL executor, so the workload routes through it
         # below; the step stays bitwise vs the fused schedule.
         mc = dataclasses.replace(mc, pp_schedule=cfg.pp_schedule)
+    if model_cfg is None and cfg.tick_lowering != "masked":
+        # --tick-lowering switch: the cost-proportional per-rank
+        # lax.switch dispatch (tpu_p2p/models/schedule.py lower()).
+        # Another manual-executor knob — it routes the workload
+        # through the IR executor even under pp_schedule=1f1b; the
+        # step stays bitwise vs the masked execution.
+        mc = dataclasses.replace(mc, tick_lowering=cfg.tick_lowering)
     host_params = F.init_flagship_params(mc)
-    if mc.pp_schedule != "1f1b":
+    if mc.pp_schedule != "1f1b" or mc.tick_lowering != "masked":
         # The manual (interleaved-machinery) executor owns tick
-        # schedules: device-major param layout + per-tick jax.vjp
-        # (tpu_p2p/models/flagship_1f1b.py).
+        # schedules and tick lowerings: device-major param layout +
+        # per-tick jax.vjp (tpu_p2p/models/flagship_1f1b.py).
         params = F.place_flagship_params_pipelined(host_params, mesh, mc)
         step = F.make_flagship_train_step_1f1b(mesh, mc)
     else:
@@ -104,11 +132,13 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
                    if mc.pp_overlap != "none" else "")
         sched_part = (f" pp_schedule={mc.pp_schedule}"
                       if mc.pp_schedule != "1f1b" else "")
+        lowering_part = (f" tick_lowering={mc.tick_lowering}"
+                         if mc.tick_lowering != "masked" else "")
         sys.stdout.write(
             f"flagship_step mesh {axes} {mc.sp_strategy}-SP "
             f"B{mc.batch} T{mc.seq} H{mc.heads} E{mc.num_experts} "
             f"S{mc.stages}x{mc.microbatches}mb {mc.dtype}"
-            f"{tp_part}{ep_part}{pp_part}{sched_part}: "
+            f"{tp_part}{ep_part}{pp_part}{sched_part}{lowering_part}: "
             f"p50 {s.p50 * 1e3:.2f}ms/step  {tok_s:,.0f} tokens/s\n"
         )
         sys.stdout.flush()
@@ -120,6 +150,7 @@ def run_flagship_step(ctx: WorkloadContext, model_cfg=None) -> dict:
             batch=mc.batch, seq=mc.seq, tokens_per_s=tok_s,
             tp_overlap=mc.tp_overlap, ep_overlap=mc.ep_overlap,
             pp_overlap=mc.pp_overlap, pp_schedule=mc.pp_schedule,
+            tick_lowering=mc.tick_lowering,
         )
     )
     return {"mesh": axes, "p50_ms": s.p50 * 1e3, "tokens_per_s": tok_s}
