@@ -17,7 +17,7 @@ SHELL    := /bin/bash
 
 NATIVE_SO := native/libtpu_p2p_native.so
 
-.PHONY: all native run test tier1 bench obs health serve serve-chaos clean
+.PHONY: all native run test tier1 bench obs health serve serve-chaos ckpt-chaos clean
 
 all: native
 
@@ -78,6 +78,17 @@ serve:
 # mesh; override with ARGS= on real hardware.
 serve-chaos:
 	$(PYTHON) -m tpu_p2p serve --chaos $(if $(ARGS),$(ARGS),--cpu-mesh 8)
+
+# Checkpoint-durability chaos smoke (docs/checkpoint_durability.md):
+# three injected storage-fault scenarios — crash mid-write →
+# supervisor re-entry from the newest intact generation, corrupt
+# newest generation → verifying-loader fallback with the skip reason
+# surfaced, transient IO errors → bounded retry with zero fallbacks —
+# each graded bitwise against an uninterrupted twin; nonzero exit
+# unless all three scenarios grade. Defaults to the simulated
+# 8-device CPU mesh; override with ARGS= on real hardware.
+ckpt-chaos:
+	$(PYTHON) -m tpu_p2p obs ckpt-smoke $(if $(ARGS),$(ARGS),--cpu-mesh 8)
 
 # `make train ARGS="--steps 100 --ckpt-dir runs/a"` — the training
 # loop (tpu_p2p/train.py): loader + step + checkpoint/resume + JSONL.

@@ -325,6 +325,13 @@ def test_main_multichip_branch_schema(capsys, monkeypatch, tmp_path):
         bench, "_serve_resilience_metrics",
         lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
     )
+    # The ckpt durability smoke runs five full training loops — real
+    # coverage lives in tests/test_ckpt_chaos.py; here exercise the
+    # failure wiring (explicit nulls, schema intact).
+    monkeypatch.setattr(
+        bench, "_ckpt_metrics",
+        lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
+    )
     compact, r = _run_main(capsys, monkeypatch, tmp_path)
     assert compact["metric"] == r["metric"]
     assert compact["value"] == r["value"]
@@ -349,6 +356,9 @@ def test_main_multichip_branch_schema(capsys, monkeypatch, tmp_path):
     assert r["detail"]["health_detect_steps"] is None
     assert r["detail"]["heal_resume_loss_delta"] is None
     assert "RuntimeError" in r["detail"]["health_error"]
+    assert r["detail"]["ckpt_recover_steps"] is None
+    assert r["detail"]["ckpt_save_ms_p50"] is None
+    assert "RuntimeError" in r["detail"]["ckpt_error"]
     assert r["unit"] == "Gbps"
     assert r["value"] > 0 and math.isfinite(r["value"])
     # vs_baseline is rounded to 4 decimals; at CPU-mesh speeds the
@@ -419,6 +429,7 @@ def test_main_multichip_bad_env_falls_back(capsys, monkeypatch, tmp_path):
     monkeypatch.setattr(bench, "_health_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_serve_resilience_metrics",
                         lambda t: {})
+    monkeypatch.setattr(bench, "_ckpt_metrics", lambda t: {})
     _, r = _run_main(capsys, monkeypatch, tmp_path)
     # Fell back to the default 24-pair cap: ceil-stride over the 56
     # ordered pairs of an 8-device mesh measures 19 of them.
@@ -447,6 +458,7 @@ def test_main_multichip_device_sourced_cells(capsys, monkeypatch,
     monkeypatch.setattr(bench, "_health_metrics", lambda t: {})
     monkeypatch.setattr(bench, "_serve_resilience_metrics",
                         lambda t: {})
+    monkeypatch.setattr(bench, "_ckpt_metrics", lambda t: {})
     _, r = _run_main(capsys, monkeypatch, tmp_path)
     d = r["detail"]
     assert d["headline_source"] == "device_trace"
@@ -556,6 +568,10 @@ def test_main_single_chip_branch_schema(capsys, monkeypatch, tmp_path):
     )
     monkeypatch.setattr(
         bench, "_serve_metrics",
+        lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
+    )
+    monkeypatch.setattr(
+        bench, "_ckpt_metrics",
         lambda t: (_ for _ in ()).throw(RuntimeError("stubbed")),
     )
     detail_path = os.path.join(str(tmp_path), "BENCH_detail.json")
@@ -698,6 +714,7 @@ def test_single_chip_headline_vs_baseline_uses_device_kind(capsys,
     monkeypatch.setattr(bench, "_serve_resilience_metrics",
                         lambda t: {})
     monkeypatch.setattr(bench, "_serve_metrics", lambda t: {})
+    monkeypatch.setattr(bench, "_ckpt_metrics", lambda t: {})
     monkeypatch.setattr(
         bench, "_loopback_size_sweep", lambda *a, **kw: [])
     _, r = _run_main(capsys, monkeypatch, tmp_path)
@@ -1042,8 +1059,12 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         # fused schedule's analytic constant) and ring_achieved_gbps
         # (ring_gbps_xla's byte-equivalent twin) for the serve
         # resilience pair (test_round15_budget_trade).
+        # Round 17 traded pp_step_ms_sched_1f1b (the fused baseline
+        # arm; zb < 1f1b enforced in-metric since round 16) and
+        # p2p_lat_us_xla (the XLA baseline arm; latency_8b_p50_us
+        # grades the same dispatch-floor family) for the checkpoint-
+        # durability pair (test_round17_budget_trade pins the move).
         "pp_bubble_frac_zb": 0.1905,
-        "pp_step_ms_sched_1f1b": 98.765,
         "pp_step_ms_sched_zb": 98.765,
         "obs_step_ms_p50": 123.456,
         # Round 12: the health pair joined the line; "devices" (the
@@ -1056,7 +1077,7 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         # four *_step_ms_overlap_none baselines moved to
         # BENCH_detail.json (never gated — only the overlap variants
         # are — never drift-quoted; the min/max_gbps precedent).
-        "p2p_lat_us_xla": 123.4567,
+        # p2p_lat_us_xla left in the round-17 trade (note above).
         "p2p_lat_us_pallas": 98.7654,
         "ring_gbps_xla": 1234.56,
         "ring_gbps_pallas": 1187.43,
@@ -1075,6 +1096,10 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         # _serve_resilience_metrics).
         "serve_preempt_recover_steps": 12,
         "serve_shed_frac_overload": 0.4861,
+        # Round 17: the checkpoint-durability pair (bench.py
+        # _ckpt_metrics).
+        "ckpt_recover_steps": 12,
+        "ckpt_save_ms_p50": 123.456,
     }
     # Every headline key must have a realistic value in this test —
     # a key added to HEADLINE_KEYS without extending this table would
@@ -1197,15 +1222,16 @@ def test_dma_transport_metrics_probe_failure_null_schema(monkeypatch):
 
 
 def test_dma_headline_keys_survive_compact_budget():
-    # Satellite contract (round 11): the four transport head-to-head
-    # keys ride the ≤1 KiB compact line at realistic widths.
-    new = ("p2p_lat_us_xla", "p2p_lat_us_pallas",
+    # Satellite contract (round 11): the transport head-to-head keys
+    # ride the ≤1 KiB compact line at realistic widths.
+    # (p2p_lat_us_xla left the line in the round-17 budget trade —
+    # test_round17_budget_trade pins that move.)
+    new = ("p2p_lat_us_pallas",
            "ring_gbps_xla", "ring_gbps_pallas")
     for k in new:
         assert k in bench.HEADLINE_KEYS, k
     detail = {
         "devices": 256,
-        "p2p_lat_us_xla": 123.4567,
         "p2p_lat_us_pallas": 98.7654,
         "ring_gbps_xla": 1234.56,
         "ring_gbps_pallas": 1187.43,
@@ -1284,9 +1310,10 @@ def test_round14_budget_trade():
     assert "obs_step_ms_p99" in bench.OBS_NULL
     assert "decode_ms_per_token" in bench.DECODE_NULL
     # pp_bubble_frac_1f1b joined the line in round 14 and left it
-    # again in the round-15 trade (test_round15_budget_trade).
-    for k in ("pp_bubble_frac_zb",
-              "pp_step_ms_sched_1f1b", "pp_step_ms_sched_zb"):
+    # again in the round-15 trade (test_round15_budget_trade);
+    # pp_step_ms_sched_1f1b followed in round 17
+    # (test_round17_budget_trade).
+    for k in ("pp_bubble_frac_zb", "pp_step_ms_sched_zb"):
         assert k in bench.HEADLINE_KEYS, k
         assert k in bench.SCHED_NULL, k
         assert k in TOLERANCES, k
@@ -1315,6 +1342,32 @@ def test_round15_budget_trade():
               "serve_shed_frac_overload"):
         assert k in bench.HEADLINE_KEYS, k
         assert k in bench.RESIL_NULL, k
+        assert k in TOLERANCES, k
+
+
+def test_round17_budget_trade():
+    # The round-17 budget trade, pinned like the round-13/14/15 ones:
+    # two BASELINE-arm keys left the compact line for the checkpoint-
+    # durability pair but still measure into BENCH_detail.json.
+    # pp_step_ms_sched_1f1b is the fused arm of the measured schedule
+    # pair — the graded claim, zb < 1f1b, is enforced inside
+    # _pp_sched_measured since round 16 and the zb arm stays graded;
+    # p2p_lat_us_xla is the XLA arm of the transport head-to-head —
+    # latency_8b_p50_us already grades the same dispatch-floor family
+    # over the same transport, and the pallas arm stays as the dma
+    # sentinel. Tolerances retired WITH them per the gate's
+    # tolerance-⊆-headline rule.
+    from tpu_p2p.obs.regress import TOLERANCES
+
+    gone = ("pp_step_ms_sched_1f1b", "p2p_lat_us_xla")
+    for k in gone:
+        assert k not in bench.HEADLINE_KEYS, k
+        assert k not in TOLERANCES, k
+    assert "pp_step_ms_sched_1f1b" in bench.SCHED_NULL
+    assert "p2p_lat_us_xla" in bench.DMA_NULL
+    for k in ("ckpt_recover_steps", "ckpt_save_ms_p50"):
+        assert k in bench.HEADLINE_KEYS, k
+        assert k in bench.CKPT_NULL, k
         assert k in TOLERANCES, k
 
 
@@ -1488,6 +1541,72 @@ def test_serve_resilience_metrics_wiring(monkeypatch):
     assert out["serve_shed_frac_overload"] is None
     assert out["serve_chaos_ok"] is False
     assert "storm_shed" in out["serve_resil_error"]
+
+
+# ------------------------------------------------------- ckpt metric
+
+
+def test_ckpt_metrics_wiring(monkeypatch):
+    # The round-17 gate numbers plumb straight out of run_ckpt_smoke
+    # (the real injected-IO-fault matrix is tests/test_ckpt_chaos.py's
+    # end-to-end; bench must only relay). A failing smoke
+    # ("ok": False) nulls the graded keys AND names the broken
+    # scenario — the HEALTH_NULL convention.
+    import tpu_p2p.obs.ckpt as ckpt_mod
+
+    from tpu_p2p.utils import timing
+
+    good = {
+        "devices": 8, "ok": True,
+        "ckpt_recover_steps": 3,
+        "ckpt_save_ms_p50": 4.25,
+        "crash_mid_write": {"ok": True},
+        "corrupt_latest": {"ok": True},
+        "transient_io": {"ok": True},
+    }
+    monkeypatch.setattr(ckpt_mod, "run_ckpt_smoke",
+                        lambda out: good)
+    out = bench._ckpt_metrics(timing)
+    assert set(out) == set(bench.CKPT_NULL)
+    assert out["ckpt_recover_steps"] == 3
+    assert out["ckpt_save_ms_p50"] == 4.25
+    assert out["ckpt_scenarios_ok"] is True
+    assert out["ckpt_error"] is None
+
+    bad = dict(good, ok=False)
+    bad["corrupt_latest"] = {"ok": False}
+    monkeypatch.setattr(ckpt_mod, "run_ckpt_smoke",
+                        lambda out: bad)
+    out = bench._ckpt_metrics(timing)
+    # Failure must not leak half-graded numbers past the gate.
+    assert out["ckpt_recover_steps"] is None
+    assert out["ckpt_save_ms_p50"] is None
+    assert out["ckpt_scenarios_ok"] is False
+    assert "corrupt_latest" in out["ckpt_error"]
+
+
+def test_ckpt_headline_keys_survive_compact_budget():
+    # Satellite contract (round 17): the checkpoint-durability pair
+    # rides the ≤1 KiB compact line at realistic widths (the general
+    # full-schema pin covers the fully-populated line; this asserts
+    # the pair specifically survives).
+    new = ("ckpt_recover_steps", "ckpt_save_ms_p50")
+    for k in new:
+        assert k in bench.HEADLINE_KEYS, k
+    detail = {
+        "devices": 256,
+        "ckpt_recover_steps": 12,
+        "ckpt_save_ms_p50": 123.456,
+    }
+    result = {
+        "metric": "all_pairs_unidir_bandwidth_avg", "value": 1234.567,
+        "unit": "Gbps", "vs_baseline": 0.7716, "detail": detail,
+    }
+    s = bench._compact_line(result, "BENCH_detail.json")
+    assert len(s.encode()) <= bench.COMPACT_LINE_MAX_BYTES
+    head = json.loads(s)["headline"]
+    for k in new:
+        assert k in head, k
 
 
 def test_decode_metrics_null_schema_on_flat_slope(monkeypatch):

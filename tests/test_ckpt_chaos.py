@@ -1,0 +1,422 @@
+"""Round-17 checkpoint durability: the bounded retry helper, the
+storage fault shapes, the crash-resilient supervisor, the obs-watch
+ckpt alerting, and the graded ckpt-chaos smoke
+(docs/checkpoint_durability.md)."""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpu_p2p.obs import faults
+from tpu_p2p.utils import checkpoint as C
+from tpu_p2p.utils.retry import retry_io
+
+
+# ----------------------------------------------------- retry helper
+
+
+def test_retry_io_succeeds_after_transient_failures():
+    calls = {"n": 0}
+    retried = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("blip")
+        return "ok"
+
+    slept = []
+    out = retry_io(flaky, attempts=5, base_delay_s=0.01,
+                   sleep=slept.append,
+                   on_retry=lambda i, e: retried.append(i))
+    assert out == "ok" and calls["n"] == 3
+    assert retried == [1, 2]
+    # Exponential backoff, deterministic (no jitter): 10 ms then 20 ms.
+    assert slept == [0.01, 0.02]
+
+
+def test_retry_io_exhausts_budget_and_reraises():
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise OSError("down")
+
+    with pytest.raises(OSError, match="down"):
+        retry_io(always, attempts=3, base_delay_s=0, sleep=lambda s: None)
+    assert calls["n"] == 3
+
+
+def test_retry_io_does_not_retry_non_matching_exceptions():
+    calls = {"n": 0}
+
+    def boom():
+        calls["n"] += 1
+        raise ValueError("not io")
+
+    with pytest.raises(ValueError):
+        retry_io(boom, attempts=5, sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_retry_io_never_swallows_simulated_crash():
+    # SimulatedCrash derives from BaseException precisely so the
+    # OSError filter (or any except-Exception cleanup) cannot eat a
+    # process death.
+    calls = {"n": 0}
+
+    def die():
+        calls["n"] += 1
+        raise faults.SimulatedCrash("/x/params.npz", 7)
+
+    with pytest.raises(faults.SimulatedCrash):
+        retry_io(die, attempts=5, sleep=lambda s: None)
+    assert calls["n"] == 1
+    assert not issubclass(faults.SimulatedCrash, Exception)
+
+
+# ------------------------------------------------ fault plan shapes
+
+
+def test_fault_plan_ckpt_fields_validated():
+    with pytest.raises(ValueError, match="ckpt_crash_after_bytes"):
+        faults.FaultPlan(ckpt_crash_after_bytes=-1)
+    with pytest.raises(ValueError, match="ckpt_io_errors"):
+        faults.FaultPlan(ckpt_io_errors=-2)
+    d = faults.FaultPlan(ckpt_crash_after_bytes=512,
+                         ckpt_corrupt_seed=3, ckpt_io_errors=2,
+                         start_step=4).describe()
+    assert "crash checkpoint save after 512 bytes" in d
+    assert "corrupt published generation (seed 3)" in d
+    assert "fail first 2 checkpoint write(s)" in d
+    assert "from step 4" in d
+
+
+def test_ckpt_crash_is_one_shot_per_plan_instance():
+    plan = faults.FaultPlan(ckpt_crash_after_bytes=64, start_step=2)
+    # Before start_step: unarmed.
+    assert faults.ckpt_crash_budget(plan, 1) is None
+    # Armed (not consumed) at/past start_step.
+    assert faults.ckpt_crash_budget(plan, 2) == 64
+    assert faults.ckpt_crash_budget(plan, 3) == 64
+    faults.mark_ckpt_crash_fired(plan)
+    # Fired: the restarted "process" re-entering with the SAME plan
+    # does not die again.
+    assert faults.ckpt_crash_budget(plan, 4) is None
+    # A FRESH plan instance gets fresh one-shot state.
+    plan2 = faults.FaultPlan(ckpt_crash_after_bytes=64, start_step=2)
+    assert faults.ckpt_crash_budget(plan2, 2) == 64
+
+
+def test_ckpt_io_error_counts_first_n_attempts():
+    plan = faults.FaultPlan(ckpt_io_errors=2)
+    got = [faults.take_ckpt_io_error(plan) for _ in range(4)]
+    assert got == [True, True, False, False]
+    assert faults.take_ckpt_io_error(None) is False
+    fresh = faults.FaultPlan(ckpt_io_errors=1)
+    assert faults.take_ckpt_io_error(fresh) is True
+
+
+def test_ckpt_corrupt_due_gated_by_start_step():
+    plan = faults.FaultPlan(ckpt_corrupt_seed=0, start_step=6)
+    assert not faults.ckpt_corrupt_due(plan, 3)
+    assert faults.ckpt_corrupt_due(plan, 6)
+    assert faults.ckpt_corrupt_due(plan, 9)
+    assert not faults.ckpt_corrupt_due(None, 9)
+
+
+def test_io_faults_only_apply_under_injecting(tmp_path):
+    # A plan that is constructed but NOT active must leave the writer
+    # alone — the injecting() dynamic extent is the application gate.
+    faults.FaultPlan(ckpt_io_errors=5, ckpt_crash_after_bytes=1)
+    stats = C.save_generation(
+        str(tmp_path), {"w": np.ones((2, 2), np.float32)}, 1)
+    assert stats["write_retries"] == 0
+    assert C.verify_generation(stats["path"]) is None
+
+
+def test_transient_io_fault_rides_the_retry(tmp_path):
+    plan = faults.FaultPlan(ckpt_io_errors=3)
+    with faults.injecting(plan):
+        stats = C.save_generation(
+            str(tmp_path), {"w": np.ones((2, 2), np.float32)}, 1)
+    assert stats["write_retries"] == 3
+    assert C.verify_generation(stats["path"]) is None
+    assert C.load_latest(str(tmp_path)).skipped == []
+
+
+def test_corrupt_fault_rots_only_from_start_step(tmp_path):
+    td = str(tmp_path)
+    plan = faults.FaultPlan(ckpt_corrupt_seed=7, start_step=4)
+    with faults.injecting(plan):
+        a = C.save_generation(td, {"w": np.ones((4, 4), np.float32)}, 2)
+        b = C.save_generation(td, {"w": np.ones((4, 4), np.float32)}, 4)
+    assert not a["corrupted"] and b["corrupted"]
+    assert C.verify_generation(a["path"]) is None
+    reason = C.verify_generation(b["path"])
+    assert reason is not None and "checksum" in reason
+    lc = C.load_latest(td)
+    assert lc.name == "gen-000002"
+    assert lc.skipped[0]["generation"] == "gen-000004"
+
+
+# ------------------------------------------------------- supervisor
+
+
+def _cfg():
+    from tpu_p2p.models import flagship as F
+
+    return F.FlagshipConfig(batch=8, seq=32, heads=4, head_dim=8,
+                            stages=2, microbatches=2, num_experts=2,
+                            capacity_factor=4.0, norm=True)
+
+
+def test_supervisor_requires_checkpointing():
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.train import run_training_supervised
+
+    mesh = F.build_mesh(8)
+    with pytest.raises(ValueError, match="ckpt_dir and ckpt_every"):
+        run_training_supervised(mesh, _cfg(), steps=2)
+    with pytest.raises(ValueError, match="max_restarts"):
+        run_training_supervised(mesh, _cfg(), steps=2,
+                                ckpt_dir="/tmp/x", ckpt_every=1,
+                                max_restarts=0)
+
+
+def test_supervisor_reenters_from_newest_intact_generation(tmp_path):
+    # The tentpole path end to end: a simulated death mid-save at
+    # step 4 re-enters from gen-000002, replays, completes — and the
+    # resumed-from generation is BITWISE the fault-free twin's (the
+    # pre-crash half is deterministic; the post-resume half is pinned
+    # by loss parity, with strict bitwise equality graded by
+    # test_resume_is_bit_exact's environment).
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.train import run_training, run_training_supervised
+
+    mesh = F.build_mesh(8)
+    cfg = _cfg()
+    ref_ck = str(tmp_path / "ref")
+    ref = run_training(mesh, cfg, steps=6, lr=5e-2, log_every=0,
+                       ckpt_dir=ref_ck, ckpt_every=2)
+    ck = str(tmp_path / "sup")
+    obs = str(tmp_path / "obs.jsonl")
+    stream = io.StringIO()
+    plan = faults.FaultPlan(ckpt_crash_after_bytes=512, start_step=4)
+    out = run_training_supervised(
+        mesh, cfg, steps=6, lr=5e-2, log_every=0, ckpt_dir=ck,
+        ckpt_every=2, fault_plan=plan, obs_jsonl=obs,
+        log_stream=stream)
+    sup = out["supervisor"]
+    assert sup["restarts"] == 1
+    assert sup["crashes"] == [
+        {"step": 4, "resume_step": 2, "lost_steps": 2}]
+    # Every published generation is complete (atomic publish).
+    for _s, name in C.list_generations(ck):
+        assert C.verify_generation(os.path.join(ck, name)) is None
+    # The resumed-from generation is bitwise the twin's.
+    pa = C._load_flat_params(os.path.join(ck, "gen-000002"))[0]
+    pb = C._load_flat_params(os.path.join(ref_ck, "gen-000002"))[0]
+    for k in pa:
+        np.testing.assert_array_equal(pa[k], pb[k], err_msg=k)
+    # The run completed with loss parity vs the twin.
+    assert out["final_loss"] == pytest.approx(ref["final_loss"],
+                                              rel=0.05)
+    # Transcript + obs verdicts carry the crash → resume transition.
+    text = stream.getvalue()
+    assert "# supervise: crashed mid-checkpoint at step 4" in text
+    assert "resuming from gen-000002" in text
+    recs = [json.loads(ln) for ln in open(obs) if ln.strip()]
+    restarts = [r for r in recs if r.get("obs") == "ckpt"
+                and r.get("event") == "crash_restart"]
+    assert len(restarts) == 1
+    assert restarts[0]["step"] == 4
+    assert restarts[0]["resume_step"] == 2
+    saves = [r for r in recs if r.get("obs") == "ckpt"
+             and r.get("event") == "save"]
+    assert saves and all(r["ok"] for r in saves)
+
+
+def test_supervisor_gives_up_past_restart_budget(tmp_path, monkeypatch):
+    # A crash LOOP (every re-entry dies again) must fail loudly after
+    # max_restarts, not spin. Forced by re-arming the one-shot crash
+    # on every save.
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.train import run_training_supervised
+
+    real_budget = faults.ckpt_crash_budget
+
+    def always_armed(plan, step):
+        if plan is not None and plan.ckpt_crash_after_bytes is not None:
+            return plan.ckpt_crash_after_bytes
+        return real_budget(plan, step)
+
+    monkeypatch.setattr(faults, "ckpt_crash_budget", always_armed)
+    mesh = F.build_mesh(8)
+    plan = faults.FaultPlan(ckpt_crash_after_bytes=8)
+    with pytest.raises(faults.SimulatedCrash):
+        run_training_supervised(
+            mesh, _cfg(), steps=4, lr=5e-2, log_every=0,
+            ckpt_dir=str(tmp_path / "ck"), ckpt_every=2,
+            fault_plan=plan, max_restarts=2)
+
+
+def test_resume_emits_fallback_receipt(tmp_path):
+    # A --resume over a rotted newest generation reports WHAT it
+    # skipped and WHY — on the summary and as an {"obs": "ckpt"}
+    # fallback record.
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.train import run_training
+
+    mesh = F.build_mesh(8)
+    cfg = _cfg()
+    ck = str(tmp_path / "ck")
+    run_training(mesh, cfg, steps=4, lr=5e-2, log_every=0,
+                 ckpt_dir=ck, ckpt_every=2)
+    fp = os.path.join(ck, "gen-000004", "params.npz")
+    with open(fp, "rb") as fh:
+        data = bytearray(fh.read())
+    data[len(data) // 2] ^= 1
+    with open(fp, "wb") as fh:
+        fh.write(bytes(data))
+    obs = str(tmp_path / "obs.jsonl")
+    out = run_training(mesh, cfg, steps=6, lr=5e-2, log_every=0,
+                       ckpt_dir=ck, resume=True, obs_jsonl=obs)
+    receipt = out["ckpt_resume"]
+    assert receipt["generation"] == "gen-000002"
+    assert receipt["step"] == 2 and out["start_step"] == 2
+    assert receipt["skipped"][0]["generation"] == "gen-000004"
+    assert "checksum" in receipt["skipped"][0]["reason"]
+    recs = [json.loads(ln) for ln in open(obs) if ln.strip()]
+    fb = [r for r in recs if r.get("obs") == "ckpt"
+          and r.get("event") == "fallback"]
+    assert len(fb) == 1 and fb[0]["generation"] == "gen-000002"
+    assert fb[0]["skipped"][0]["generation"] == "gen-000004"
+
+
+# ---------------------------------------------------- watch alerting
+
+
+def _watch(lines, *args):
+    from tpu_p2p.obs.health import watch_main
+
+    path = _watch.dir + "/obs.jsonl"
+    with open(path, "w") as fh:
+        fh.write("\n".join(json.dumps(r) for r in lines) + "\n")
+    out = io.StringIO()
+    rc = watch_main([path, *args], stream=out)
+    return rc, out.getvalue()
+
+
+def test_watch_alerts_on_ckpt_fallback_and_crash(tmp_path):
+    _watch.dir = str(tmp_path)
+    # Clean saves + a clean load: routine, no alert, summary printed.
+    rc, text = _watch([
+        {"obs": "ckpt", "event": "save", "step": 2,
+         "generation": "gen-000002", "save_ms": 4.2, "ok": True},
+        {"obs": "ckpt", "event": "load", "step": 2,
+         "generation": "gen-000002", "skipped": [], "ok": True},
+    ])
+    assert rc == 0
+    assert "ALERT" not in text
+    assert "# watch: 2 ckpt row(s), 0 fallback/crash" in text
+    # A fallback (storage damage survived) always alerts…
+    rc, text = _watch([
+        {"obs": "ckpt", "event": "fallback", "step": 6,
+         "generation": "gen-000006",
+         "skipped": [{"generation": "gen-000009",
+                      "reason": "checksum mismatch in params.npz"}],
+         "ok": True},
+    ])
+    assert rc == 1
+    assert "# ALERT step 6 ckpt_fallback" in text
+    # …as does a supervisor crash-restart.
+    rc, text = _watch([
+        {"obs": "ckpt", "event": "crash_restart", "step": 4,
+         "resume_step": 2, "restarts": 1, "ok": False},
+    ])
+    assert rc == 1
+    assert "ckpt_crash_restart" in text
+    # --expect-alerts inverts (the chaos CI contract).
+    rc, _ = _watch([
+        {"obs": "ckpt", "event": "crash_restart", "step": 4,
+         "resume_step": 2, "restarts": 1, "ok": False},
+    ], "--expect-alerts")
+    assert rc == 0
+
+
+def test_watch_training_log_contract_unchanged(tmp_path):
+    # No ckpt rows ⇒ no ckpt summary line: the round-12 byte contract
+    # for training-log watches (and its golden) holds.
+    _watch.dir = str(tmp_path)
+    rc, text = _watch([
+        {"obs": "step", "step": 1, "step_ms": 10.0, "spans": {}},
+        {"obs": "step", "step": 2, "step_ms": 10.1, "spans": {}},
+    ])
+    assert rc == 0
+    assert "ckpt row" not in text
+    assert "# watch: 0 alert(s) over 2 step row(s)" in text
+
+
+# ------------------------------------------------- chaos smoke (e2e)
+
+
+@pytest.mark.slow  # tier-1 budget (~80 s: five full training runs on
+# the 8-dev mesh); the pieces stay tier-1-covered above and in
+# test_checkpoint.py, and the smoke itself rides `make ckpt-chaos` +
+# bench's _ckpt_metrics.
+def test_ckpt_smoke_end_to_end():
+    import sys
+
+    from tpu_p2p.obs.ckpt import run_ckpt_smoke
+
+    res = run_ckpt_smoke(out=sys.stderr)
+    assert res["crash_mid_write"]["ok"], res["crash_mid_write"]
+    assert res["corrupt_latest"]["ok"], res["corrupt_latest"]
+    assert res["transient_io"]["ok"], res["transient_io"]
+    assert res["ok"]
+    # Both recovery scenarios lose at most one save interval.
+    assert res["ckpt_recover_steps"] == res["ckpt_every"]
+    assert res["ckpt_save_ms_p50"] > 0
+
+
+@pytest.mark.slow  # tier-1 budget (~35 s: heal run + twin on the
+# 8-dev mesh). Satellite (r17): heal + rotted-newest COMPOSITION —
+# the reshard resumes from the fallback generation.
+def test_heal_composes_with_rotted_newest_generation(tmp_path):
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.train import run_training, run_training_with_heal
+
+    mesh = F.build_mesh(8)
+    cfg = _cfg()
+    ck = str(tmp_path / "ck")
+    # Seed the ladder: gens at 2 and 4, then rot the newest.
+    run_training(mesh, cfg, steps=4, lr=1e-2, log_every=0,
+                 ckpt_dir=ck, ckpt_every=2)
+    fp = os.path.join(ck, "gen-000004", "params.npz")
+    with open(fp, "rb") as fh:
+        data = bytearray(fh.read())
+    data[len(data) // 2] ^= 1
+    with open(fp, "wb") as fh:
+        fh.write(bytes(data))
+    # Heal-protected continuation: the initial half resumes through
+    # the verifying ladder (fallback to gen-000002), then loses a
+    # host and reshards — from the newest INTACT generation.
+    plan = faults.FaultPlan(lost_host=7, start_step=3)
+    obs = str(tmp_path / "obs.jsonl")
+    out = run_training_with_heal(
+        mesh, cfg, steps=8, lr=1e-2, log_every=0, ckpt_dir=ck,
+        # ckpt_every larger than the run: no NEW generation lands
+        # before the loss, so the heal must reshard from the ladder
+        # the rot left behind.
+        ckpt_every=10, obs_jsonl=obs, fault_plan=plan, resume=True)
+    assert out["heal"] is not None
+    assert out["heal"]["resume_step"] == 2
+    assert out["heal"]["devices"] == 4
+    # The post-heal run's own resume receipt shows the fallback.
+    receipt = out["ckpt_resume"]
+    assert receipt["generation"] == "gen-000002"
+    assert receipt["skipped"][0]["generation"] == "gen-000004"

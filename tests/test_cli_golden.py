@@ -120,6 +120,25 @@ SUMMARY_PATTERNS = {
     # asserts rc 0, i.e. ALL THREE scenarios must grade — the
     # acceptance criterion rides this pin.
     "serve_chaos": ["serve", "--cpu-mesh", "8", "--chaos"],
+    # The round-17 crash-resilient supervisor end to end: a simulated
+    # process death mid-checkpoint at step 4 (--fault-ckpt-crash-bytes
+    # through the interposed writer), supervisor re-entry from the
+    # newest intact generation (gen-000002), deterministic replay to
+    # completion. The crash→fallback→resume transcript (step numbers,
+    # generation names, restart count, resume receipt) is
+    # schedule-deterministic and stays pinned; the final-loss float
+    # masks. {TMP} resolves to a fresh temp dir per run (the
+    # checkpoint dir must not land in the repo), and rc 0 asserts the
+    # supervisor actually recovered.
+    "train_supervise": ["train", "--cpu-mesh", "8", "--supervise",
+                        "--steps", "6", "--log-every", "0",
+                        "--batch", "8", "--seq", "16", "--heads", "4",
+                        "--head-dim", "8", "--stages", "2",
+                        "--microbatches", "2", "--experts", "2",
+                        "--ckpt-dir", "{TMP}/ck",
+                        "--ckpt-every", "2",
+                        "--fault-ckpt-crash-bytes", "512",
+                        "--fault-at-step", "4"],
     # The round-12 watch subcommand end to end over a checked-in
     # deterministic obs stream (tests/golden/obs_watch_fixture.jsonl):
     # one embedded health verdict re-printed + one straggler re-scored
@@ -188,10 +207,14 @@ def mask_floats(text: str) -> str:
 
 
 def _run_cli(args=ARGS) -> str:
-    proc = subprocess.run(
-        [sys.executable, "-m", "tpu_p2p", *args],
-        capture_output=True, text=True, cwd=REPO, timeout=540,
-    )
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="cli_golden_") as td:
+        args = [a.replace("{TMP}", td) for a in args]
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_p2p", *args],
+            capture_output=True, text=True, cwd=REPO, timeout=540,
+        )
     assert proc.returncode == 0, proc.stderr[-2000:]
     return proc.stdout
 

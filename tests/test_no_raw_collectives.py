@@ -153,6 +153,11 @@ def _all_pkg_files():
 # applied there and ONLY there (apply_serve_faults) — a clamp or
 # burst consulted from batcher/engine code would skew serving
 # behavior the chaos grader could never attribute.
+# Round 17 added utils/checkpoint.py: the storage faults (crash
+# mid-write, published-generation rot, transient IO errors) are
+# applied ONLY by the interposed generation writer there — an IO
+# fault applied from any other code would corrupt state the
+# durability grader (make ckpt-chaos) could never attribute.
 
 _FAULT_CALL = re.compile(
     r"(?:\bactive_plan|\b_fault_throttle)\s*\("
@@ -172,6 +177,7 @@ FAULT_ALLOWED = (
     os.path.join("obs", "faults.py"),
     os.path.join("parallel", "collectives.py"),
     os.path.join("serve", "resilience.py"),
+    os.path.join("utils", "checkpoint.py"),
 )
 
 
@@ -252,6 +258,23 @@ def test_fault_lint_sees_the_wrapper_modules():
     # (resilience.apply_serve_faults) must live where the allowlist
     # says it does.
     assert os.path.join("serve", "resilience.py") in hits, hits
+    # Round 17: the storage-fault application point (the interposed
+    # generation writer's _io_session) must live in
+    # utils/checkpoint.py — i.e. checkpoint.py IS scanned by this
+    # lint and allowlisted for a reason; if the writer moves, the
+    # lint must fail here, not silently allowlist a file that no
+    # longer applies anything.
+    assert os.path.join("utils", "checkpoint.py") in hits, hits
+    ckpt_src = os.path.join(PKG, "utils", "checkpoint.py")
+    with open(ckpt_src) as fh:
+        ckpt_text = fh.read()
+    for anchor in ("_io_session", "take_ckpt_io_error",
+                   "ckpt_crash_budget", "ckpt_corrupt_due"):
+        assert anchor in ckpt_text, (
+            f"the storage-fault writer lost its {anchor} application "
+            "site — extend FAULT_ALLOWED (and this self-test) to "
+            "wherever it went"
+        )
 
 
 def test_pallas_lint_sees_the_kernel_modules():

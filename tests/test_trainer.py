@@ -151,6 +151,48 @@ def test_hygiene_resume_is_bit_exact(tmp_path):
                                       err_msg=k)
 
 
+def test_adamw_ckpt_publishes_params_and_opt_in_one_generation(tmp_path):
+    # Satellite bugfix (r17): params and opt_state used to be two
+    # independent non-atomic writes — a crash between them yielded
+    # params@N + opt@N-1, which resume accepted. Now BOTH ride one
+    # atomic generation publish (one manifest covers them), and a
+    # damaged opt file fails the generation as a whole: the verifying
+    # loader falls back to the previous generation instead of pairing
+    # mismatched state.
+    import json as _json
+
+    from tpu_p2p.utils import checkpoint as C
+
+    mesh = F.build_mesh(8)
+    cfg = _cfg()
+    ck = str(tmp_path / "adamw")
+    run_training(mesh, cfg, steps=4, lr=1e-2, log_every=0,
+                 optimizer="adamw", weight_decay=0.01,
+                 ckpt_dir=ck, ckpt_every=2)
+    gen = os.path.join(ck, "gen-000004")
+    with open(os.path.join(gen, C.MANIFEST)) as fh:
+        manifest = _json.load(fh)
+    assert set(manifest["files"]) >= {"params.npz", "opt_state.npz",
+                                      "train_schedule.json"}
+    assert manifest["step"] == 4
+    # Rot the opt half only: the WHOLE generation is rejected…
+    fp = os.path.join(gen, "opt_state.npz")
+    with open(fp, "rb") as fh:
+        data = bytearray(fh.read())
+    data[len(data) // 2] ^= 1
+    with open(fp, "wb") as fh:
+        fh.write(bytes(data))
+    reason = C.verify_generation(gen)
+    assert reason is not None and "opt_state.npz" in reason
+    # …and resume lands on gen-000002 with a MATCHED params/opt pair.
+    out = run_training(mesh, cfg, steps=4, lr=1e-2, log_every=0,
+                       optimizer="adamw", weight_decay=0.01,
+                       ckpt_dir=ck, resume=True)
+    assert out["start_step"] == 2
+    assert out["ckpt_resume"]["generation"] == "gen-000002"
+    assert out["ckpt_resume"]["skipped"][0]["generation"] == "gen-000004"
+
+
 def test_cosine_schedule_trains():
     mesh = F.build_mesh(8)
     out = run_training(mesh, _cfg(), steps=6, lr=2e-2, log_every=0,

@@ -324,6 +324,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_p2p.serve.engine import main as serve_main
 
         return serve_main(list(argv[1:]))
+    if argv and argv[0] == "train":
+        # ``python -m tpu_p2p train`` — the training loop
+        # (tpu_p2p/train.py: durable checkpoint/resume, --heal,
+        # --supervise). Dispatched like obs/serve so the golden
+        # harness (and users) reach every entry point through ONE
+        # program; ``python -m tpu_p2p.train`` stays equivalent.
+        from tpu_p2p.train import main as train_main
+
+        return train_main(list(argv[1:]))
     args = build_parser().parse_args(argv)
     try:
         if args.cpu_mesh:

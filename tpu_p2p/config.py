@@ -95,6 +95,18 @@ def parse_sweep(text: str) -> Tuple[int, ...]:
     return tuple(parse_size(p) for p in text.split(","))
 
 
+CKPT_KEEP = 3
+# Checkpoint retention (round 17, docs/checkpoint_durability.md):
+# how many published ``gen-<step>/`` generations
+# ``utils/checkpoint.save_generation`` keeps after each atomic
+# publish. ONE definition governs the save default and the
+# ``train.py --ckpt-keep`` CLI default alike (the PP_SCHEDULES
+# single-source rule). Three generations is the smallest ladder that
+# still recovers when the newest generation is damaged AND the
+# fallback one is mid-overwrite: the verifying loader
+# (checkpoint.load_latest) walks newest → oldest and settles on the
+# first intact one.
+
 PATTERNS = (
     "pairwise",      # all-pairs matrix — the reference program itself
     "loopback",      # self-edge / same-host copy (BASELINE configs[0])

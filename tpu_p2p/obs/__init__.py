@@ -21,6 +21,12 @@ real steps, MegaScale-style (Jiang et al., 2024 — PAPERS.md):
   headline against the ``BENCH_r*.json`` trajectory with per-key
   tolerances and exits nonzero on regression
   (``python -m tpu_p2p obs``).
+- :mod:`tpu_p2p.obs.faults` — deterministic fault injection
+  (links/hosts, serve pools, and the round-17 storage IO shapes) the
+  health / serve-chaos / ckpt-chaos smokes grade against.
+- :mod:`tpu_p2p.obs.ckpt` — the checkpoint-durability chaos smoke
+  (``python -m tpu_p2p obs ckpt-smoke`` / ``make ckpt-chaos``,
+  docs/checkpoint_durability.md).
 
 Deliberately import-light: :mod:`tpu_p2p.parallel.collectives` imports
 the ledger at module load, so nothing here may import the parallel /

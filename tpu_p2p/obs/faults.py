@@ -58,12 +58,38 @@ injects, applied exclusively by ``serve/resilience.py``:
   schedules are step-indexed, so the graded claim is that a slow host
   changes latency telemetry and NOTHING else.
 
+Round 17 added the STORAGE-scoped fault shapes the checkpoint chaos
+smoke (``python -m tpu_p2p obs ckpt-smoke`` / ``make ckpt-chaos``,
+docs/checkpoint_durability.md) injects, applied exclusively by the
+interposed writer in ``utils/checkpoint.py``:
+
+- **Crash mid-write** (``ckpt_crash_after_bytes``): the first
+  generation save at ``step >= start_step`` writes that many bytes,
+  fsyncs the partial file, and dies with :class:`SimulatedCrash` — a
+  ``BaseException``, so no error handling short of the supervisor's
+  explicit catch (``train.py --supervise``) can mistake it for a
+  recoverable error. One-shot per plan instance: the restarted
+  "process" re-entering the loop with the same plan does not re-die,
+  exactly like a real crash.
+- **Published-generation corruption** (``ckpt_corrupt_seed``): a
+  seeded single-bit flip in the just-published generation's
+  ``params.npz`` at ``step >= start_step`` — the deterministic
+  stand-in for at-rest bit rot, forcing the verifying loader's
+  checksum fallback.
+- **Transient IO errors** (``ckpt_io_errors``): the first N write
+  attempts under the plan raise ``OSError`` before touching the file
+  — the blip the bounded retry helper
+  (:func:`tpu_p2p.utils.retry.retry_io`) must absorb with zero
+  fallbacks.
+
 Fault-injection wrappers live ONLY here, in
-``parallel/collectives.py``, and in ``serve/resilience.py`` —
-enforced by the grep-lint in tests/test_no_raw_collectives.py, the
-same way raw collectives are confined: a throttle call in model code
-would distort transport the ledger (and the detectors) could never
-attribute.
+``parallel/collectives.py``, ``serve/resilience.py``, and
+``utils/checkpoint.py`` — enforced by the grep-lint in
+tests/test_no_raw_collectives.py, the same way raw collectives are
+confined: a throttle call in model code would distort transport the
+ledger (and the detectors) could never attribute, and an IO fault
+applied outside the checkpoint writer would corrupt state the
+durability grader could never attribute.
 """
 
 from __future__ import annotations
@@ -73,8 +99,32 @@ from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
-__all__ = ["FaultPlan", "injecting", "active_plan", "host_lost",
-           "maybe_slow_host"]
+__all__ = ["FaultPlan", "SimulatedCrash", "injecting", "active_plan",
+           "host_lost", "maybe_slow_host", "ckpt_crash_budget",
+           "mark_ckpt_crash_fired", "take_ckpt_io_error",
+           "ckpt_corrupt_due"]
+
+
+class SimulatedCrash(BaseException):
+    """Simulated process death mid-checkpoint-write
+    (``FaultPlan.ckpt_crash_after_bytes``).
+
+    Derives from ``BaseException`` on purpose: ordinary
+    ``except Exception`` cleanup — including the retry helper's
+    ``OSError`` filter — must not swallow a process death; only the
+    crash-resilient supervisor (``train.run_training_supervised``)
+    and the chaos tests catch it explicitly. ``path`` names the file
+    being written; ``step`` is attached by the checkpoint layer (the
+    training step whose save died).
+    """
+
+    def __init__(self, path: str, bytes_written: int) -> None:
+        super().__init__(
+            f"simulated process death after {bytes_written} bytes "
+            f"into {path}")
+        self.path = path
+        self.bytes_written = int(bytes_written)
+        self.step: Optional[int] = None
 
 
 @dataclass(frozen=True)
@@ -99,6 +149,14 @@ class FaultPlan:
     page_pool_clamp: Optional[int] = None  # usable KV pages per shard
     storm_step: Optional[int] = None  # burst arrival scheduler step
     storm_requests: int = 0  # burst size (> 0 iff storm_step set)
+    # Storage-scoped shapes (round 17; applied ONLY by the interposed
+    # writer in utils/checkpoint.py — docs/checkpoint_durability.md):
+    ckpt_crash_after_bytes: Optional[int] = None  # simulated process
+    # death after this many bytes of one generation save (one-shot
+    # per plan; gated by start_step on the SAVE's training step)
+    ckpt_corrupt_seed: Optional[int] = None  # seeded one-bit flip in
+    # the published generation's params.npz (gated by start_step)
+    ckpt_io_errors: int = 0  # first-N write attempts raise OSError
     start_step: int = 0
 
     def __post_init__(self) -> None:
@@ -135,6 +193,17 @@ class FaultPlan:
             raise ValueError(
                 f"storm_step must be >= 0, got {self.storm_step}"
             )
+        if (self.ckpt_crash_after_bytes is not None
+                and self.ckpt_crash_after_bytes < 0):
+            raise ValueError(
+                f"ckpt_crash_after_bytes must be >= 0 (0 = die before "
+                f"the first byte), got {self.ckpt_crash_after_bytes}"
+            )
+        if self.ckpt_io_errors < 0:
+            raise ValueError(
+                f"ckpt_io_errors must be >= 0, got "
+                f"{self.ckpt_io_errors}"
+            )
         if self.start_step < 0:
             raise ValueError(f"start_step must be >= 0, got "
                              f"{self.start_step}")
@@ -155,6 +224,15 @@ class FaultPlan:
         if self.storm_step is not None:
             parts.append(f"storm {self.storm_requests} requests at "
                          f"step {self.storm_step}")
+        if self.ckpt_crash_after_bytes is not None:
+            parts.append(f"crash checkpoint save after "
+                         f"{self.ckpt_crash_after_bytes} bytes")
+        if self.ckpt_corrupt_seed is not None:
+            parts.append(f"corrupt published generation "
+                         f"(seed {self.ckpt_corrupt_seed})")
+        if self.ckpt_io_errors:
+            parts.append(f"fail first {self.ckpt_io_errors} "
+                         "checkpoint write(s)")
         tail = f" from step {self.start_step}" if self.start_step else ""
         return ("; ".join(parts) or "no-op plan") + tail
 
@@ -212,3 +290,64 @@ def maybe_slow_host(plan: Optional[FaultPlan], step: int,
         sleep(plan.slow_ms / 1e3)
         return True
     return False
+
+
+# ------------------------------------------------- storage IO faults
+# Mutable consumption state for the round-17 checkpoint faults, keyed
+# on PLAN IDENTITY (``is``, not equality): a crash is a process death
+# — the supervisor re-entering the training loop with the SAME plan
+# must not die again (a real restarted process would not), while a
+# fresh plan in a fresh test gets fresh counters. One active plan at
+# a time (the `injecting` contract) keeps this a single slot.
+
+_IO_STATE: dict = {"plan": None, "crash_fired": False, "io_errors": 0}
+
+
+def _io_state(plan: FaultPlan) -> dict:
+    if _IO_STATE["plan"] is not plan:
+        _IO_STATE.update(plan=plan, crash_fired=False, io_errors=0)
+    return _IO_STATE
+
+
+def ckpt_crash_budget(plan: Optional[FaultPlan],
+                      step: int) -> Optional[int]:
+    """Byte budget for THIS generation save if the simulated crash
+    should arm now (``step`` is the save's training step), else None.
+    Arming does not consume the fault — :func:`mark_ckpt_crash_fired`
+    does, when the budget is actually exceeded — so a save smaller
+    than the budget leaves the crash pending for the next one."""
+    if (plan is None or plan.ckpt_crash_after_bytes is None
+            or int(step) < plan.start_step):
+        return None
+    if _io_state(plan)["crash_fired"]:
+        return None
+    return plan.ckpt_crash_after_bytes
+
+
+def mark_ckpt_crash_fired(plan: FaultPlan) -> None:
+    """Consume the one-shot crash: the writer calls this at the
+    moment it raises :class:`SimulatedCrash`."""
+    _io_state(plan)["crash_fired"] = True
+
+
+def take_ckpt_io_error(plan: Optional[FaultPlan]) -> bool:
+    """→ True when this write attempt should fail transiently (the
+    first ``ckpt_io_errors`` attempts under the plan do; every later
+    attempt succeeds — the retry helper's budget is graded against
+    exactly this count)."""
+    if plan is None or not plan.ckpt_io_errors:
+        return False
+    st = _io_state(plan)
+    if st["io_errors"] < plan.ckpt_io_errors:
+        st["io_errors"] += 1
+        return True
+    return False
+
+
+def ckpt_corrupt_due(plan: Optional[FaultPlan], step: int) -> bool:
+    """Should the generation just published at ``step`` be
+    bit-flipped? (Every publish at ``step >= start_step`` is — the
+    smoke points ``start_step`` at the final save so exactly one
+    generation rots.)"""
+    return (plan is not None and plan.ckpt_corrupt_seed is not None
+            and int(step) >= plan.start_step)

@@ -624,9 +624,13 @@ def _build_watch_parser() -> argparse.ArgumentParser:
                     "health verdicts: embedded {'obs': 'health'} "
                     "records are re-printed, stragglers are "
                     "re-scored from the step rows (median/MAD), so "
-                    "un-monitored logs alert too, and serve "
+                    "un-monitored logs alert too, serve "
                     "{'obs': 'request'} shed verdicts alert past "
-                    "--max-shed-frac. Exit codes (docs/health.md): "
+                    "--max-shed-frac, and checkpoint {'obs': 'ckpt'} "
+                    "fallback / crash-restart verdicts always alert "
+                    "(storage damage is never routine; "
+                    "docs/checkpoint_durability.md). Exit codes "
+                    "(docs/health.md): "
                     "0 = no alerts, 1 = alerts (inverted by "
                     "--expect-alerts), 2 = unreadable input.",
     )
@@ -672,10 +676,12 @@ def watch_main(argv: Optional[Sequence[str]] = None,
     steps = 0
     requests = 0
     shed = 0
+    ckpt_rows = 0
+    ckpt_bad = 0
 
     def handle(line: str) -> bool:
         """→ True when this row alerted."""
-        nonlocal alerts, steps, requests, shed
+        nonlocal alerts, steps, requests, shed, ckpt_rows, ckpt_bad
         line = line.strip()
         if not line:
             return False
@@ -703,6 +709,25 @@ def watch_main(argv: Optional[Sequence[str]] = None,
                                                    4)})
                     out.write(f"# ALERT {v.describe()}\n")
                     hit = True
+        elif rec.get("obs") == "ckpt":
+            # Checkpoint verdicts (docs/checkpoint_durability.md):
+            # clean saves/loads are routine; a FALLBACK (the verifying
+            # loader skipped damaged generations) or a CRASH_RESTART
+            # (the supervisor re-entered after a death mid-write)
+            # means storage actually failed — always an incident,
+            # whatever the recovery outcome.
+            ckpt_rows += 1
+            event = rec.get("event") or "?"
+            if event in ("fallback", "crash_restart") \
+                    or rec.get("ok") is False:
+                ckpt_bad += 1
+                detail = {k: v for k, v in rec.items()
+                          if k not in ("obs", "event", "step")}
+                v = HealthVerdict(kind=f"ckpt_{event}",
+                                  step=int(rec.get("step") or 0),
+                                  detail=detail)
+                out.write(f"# ALERT {v.describe()}\n")
+                hit = True
         elif rec.get("obs") == "health":
             v = HealthVerdict(kind=rec.get("verdict", "?"),
                               step=int(rec.get("step", 0)),
@@ -746,6 +771,10 @@ def watch_main(argv: Optional[Sequence[str]] = None,
         # watches (and their golden) keep the round-12 byte contract.
         out.write(f"# watch: {requests} request row(s), {shed} shed "
                   f"(frac {shed / requests:.4f})\n")
+    if ckpt_rows:
+        # Same contract: the line exists only when ckpt records do.
+        out.write(f"# watch: {ckpt_rows} ckpt row(s), {ckpt_bad} "
+                  "fallback/crash\n")
     out.write(f"# watch: {alerts} alert(s) over {steps} step row(s)\n")
     out.flush()
     if args.expect_alerts:

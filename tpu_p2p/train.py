@@ -18,10 +18,22 @@ Mechanics worth knowing:
   exactly the batches the interrupted run would have — a 6-step run
   checkpointed at 4 and resumed for 2 reproduces the uninterrupted
   6-step run bit-for-bit (pinned in tests/test_trainer.py).
-- **One rolling checkpoint.** ``--ckpt-every N`` overwrites
-  ``ckpt_dir`` each time (params + step metadata); ``--resume`` picks
-  it up and continues from the recorded step. Cross-mesh resume works
-  (restore is a ``device_put`` under the target mesh's specs).
+- **Durable multi-generation checkpoints** (round 17,
+  docs/checkpoint_durability.md). ``--ckpt-every N`` atomically
+  publishes a ``gen-<step>/`` under ``ckpt_dir`` (params + optimizer
+  state + schedule metadata in ONE generation, per-array checksums in
+  the manifest, ``LATEST`` pointer updated only after publish),
+  retaining the last ``--ckpt-keep`` generations; ``--resume`` routes
+  through the VERIFYING loader (``checkpoint.load_latest``), falling
+  back generation by generation to the newest intact one and
+  reporting what it skipped and why (``{"obs": "ckpt"}`` records on
+  the ``--obs-jsonl`` stream — ``obs watch`` alerts on fallbacks).
+  Cross-mesh resume works (restore is a ``device_put`` under the
+  target mesh's specs). ``--supervise`` wraps the loop in the
+  crash-resilient supervisor: a (simulated) process death
+  mid-checkpoint re-enters from the newest intact generation with
+  the same deterministic batch stream, so an interrupted-at-any-point
+  run reproduces the uninterrupted run's trajectory.
 - **Donated params.** The loop reassigns ``params`` every step, so the
   step is built with ``donate=True`` and XLA updates in place.
 - **Wall-clock tokens/s.** The JSONL log reports wall-clock rates
@@ -93,6 +105,7 @@ def _make_eval_fn(mesh, cfg):
 def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
                  seed: int = 0, log_every: int = 10,
                  ckpt_dir: Optional[str] = None, ckpt_every: int = 0,
+                 ckpt_keep: Optional[int] = None,
                  resume: bool = False, log_path: Optional[str] = None,
                  log_stream=None, optimizer: str = "sgd",
                  weight_decay: float = 0.0, eval_every: int = 0,
@@ -153,13 +166,16 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
 
     start_step = 0
     specs = F.flagship_param_specs(mesh, cfg)
-    if resume and ckpt_dir and os.path.exists(
-        os.path.join(ckpt_dir, "params.npz")
-    ):
+    ckpt_resume = None
+    if resume and ckpt_dir and C.has_checkpoint(ckpt_dir):
         # Load host-side first: key validation must precede placement
         # (placing looks specs up per checkpoint key and would KeyError
-        # confusingly on a config/checkpoint mismatch).
-        host, start_step = C.load_params(ckpt_dir)
+        # confusingly on a config/checkpoint mismatch). load_latest is
+        # the VERIFYING loader: checksums re-checked, damaged
+        # generations skipped newest-first with the reason recorded
+        # (emitted as an {"obs": "ckpt"} fallback record below).
+        ckpt_resume = C.load_latest(ckpt_dir)
+        host, start_step = ckpt_resume.params, ckpt_resume.step
         want_shapes = F.flagship_param_shapes(cfg)
         want_dtype = np.dtype(cfg.params_dtype)
         problems = []
@@ -245,14 +261,20 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
         # Template (structure + shardings) for a fresh start AND for
         # restoring a saved state into.
         opt_state = F.init_optimizer(tx, params)
-        if start_step and ckpt_dir:
-            if not os.path.exists(os.path.join(ckpt_dir, "opt_state.npz")):
+        if start_step and ckpt_resume is not None:
+            # The optimizer state lives INSIDE the loaded generation
+            # (published atomically with the params — a torn
+            # params@N/opt@N-1 pairing cannot exist there; legacy flat
+            # dirs keep the expect_step guard doing that work).
+            ckpt_src = ckpt_resume.path
+            if not os.path.exists(os.path.join(ckpt_src,
+                                               "opt_state.npz")):
                 raise ValueError(
-                    f"resuming an optax run from {ckpt_dir}, but the "
+                    f"resuming an optax run from {ckpt_src}, but the "
                     "checkpoint has no optimizer state (saved by the "
                     "plain-sgd path?)"
                 )
-            sched_path = os.path.join(ckpt_dir, _SCHED_META)
+            sched_path = os.path.join(ckpt_src, _SCHED_META)
             if os.path.exists(sched_path):  # absent in pre-r2 ckpts
                 with open(sched_path) as fh:
                     saved = json.load(fh)
@@ -262,29 +284,30 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
                 ]
                 if diffs:
                     raise ValueError(
-                        f"resume at {ckpt_dir} changes the optimizer/"
+                        f"resume at {ckpt_src} changes the optimizer/"
                         "LR-schedule shape mid-run: "
                         + "; ".join(diffs)
                         + " — pass the original flags (a different "
                         "--steps reshapes cosine decay_steps)"
                     )
-            opt_state = C.load_opt_state(ckpt_dir, opt_state,
+            opt_state = C.load_opt_state(ckpt_src, opt_state,
                                          expect_step=start_step)
         step_fn = F.make_flagship_optax_step(mesh, cfg, tx,
                                              lm=bool(cfg.vocab),
                                              donate=True)
     else:
-        if start_step and ckpt_dir and os.path.exists(
-            os.path.join(ckpt_dir, "opt_state.npz")
+        if start_step and ckpt_resume is not None and os.path.exists(
+            os.path.join(ckpt_resume.path, "opt_state.npz")
         ):
             # The mirror of the missing-opt-state guard: resuming a
             # hygiene/adamw checkpoint without those flags would
             # silently drop the schedule count and moments mid-curve.
             raise ValueError(
-                f"checkpoint at {ckpt_dir} carries optimizer state, but "
-                "this run uses the plain-sgd path — pass the original "
-                "--optimizer/--clip-norm/--warmup-steps/--schedule "
-                "flags (or remove opt_state.npz to discard it)"
+                f"checkpoint at {ckpt_resume.path} carries optimizer "
+                "state, but this run uses the plain-sgd path — pass "
+                "the original --optimizer/--clip-norm/--warmup-steps/"
+                "--schedule flags (or choose a fresh --ckpt-dir to "
+                "discard it)"
             )
         if cfg.vocab:
             step_fn = F.make_flagship_lm_train_step(mesh, cfg, lr=lr,
@@ -325,20 +348,41 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
         # not grow implicit new shapes.
         _emit_to(obs_jsonl, rec)
 
+    if ckpt_resume is not None and obs_jsonl:
+        # The verifying loader's verdict rides the obs stream
+        # (docs/checkpoint_durability.md): a clean load is event
+        # "load"; skipped generations make it a "fallback" — the
+        # storage-damage alert `obs watch` raises on.
+        emit_obs({"obs": "ckpt",
+                  "event": ("fallback" if ckpt_resume.skipped
+                            else "load"),
+                  "step": int(start_step),
+                  "generation": ckpt_resume.name,
+                  "skipped": ckpt_resume.skipped,
+                  "ok": True})
+
     def save_ckpt(step_no):
-        C.save_params(ckpt_dir, params, step=step_no)
-        if opt_state is not None:
-            C.save_opt_state(ckpt_dir, opt_state, step=step_no)
-            with open(os.path.join(ckpt_dir, _SCHED_META), "w") as fh:
-                json.dump(sched_meta, fh)
-        else:
-            # Rolling overwrite: never leave a previous run's optimizer
-            # state (or its schedule metadata) paired with this run's
-            # params.
-            C.clear_opt_state(ckpt_dir)
-            sp = os.path.join(ckpt_dir, _SCHED_META)
-            if os.path.exists(sp):
-                os.remove(sp)
+        # ONE atomic generation publish: params + optimizer state +
+        # schedule metadata land together or not at all
+        # (checkpoint.save_generation — write-temp, fsync, single
+        # rename, LATEST updated only after; a crash at any byte
+        # leaves the previous generations untouched). The save
+        # verdict rides the obs stream as an {"obs": "ckpt"} record.
+        t0s = time.monotonic()
+        stats = C.save_generation(
+            ckpt_dir, params, step_no, opt_state=opt_state,
+            sched_meta=sched_meta if opt_state is not None else None,
+            keep=ckpt_keep)
+        save_ms = round((time.monotonic() - t0s) * 1e3, 3)
+        if obs_jsonl:
+            emit_obs({"obs": "ckpt", "event": "save",
+                      "step": int(step_no),
+                      "generation": stats["name"],
+                      "save_ms": save_ms,
+                      "bytes": stats["bytes"],
+                      "write_retries": stats["write_retries"],
+                      "ok": True})
+        return stats
 
     import contextlib
 
@@ -496,6 +540,12 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
         "final_loss": final,
         "params": params,
     }
+    if ckpt_resume is not None:
+        # What the verifying loader settled on (and what it refused):
+        # the resume ladder's receipt, for callers and the smoke.
+        out["ckpt_resume"] = {"generation": ckpt_resume.name,
+                              "step": ckpt_resume.step,
+                              "skipped": ckpt_resume.skipped}
     if tl is not None:
         summary = tl.summary_record()
         emit_obs(summary)
@@ -545,15 +595,22 @@ def run_training_with_heal(mesh, cfg, *, steps: int,
         from tpu_p2p.utils import checkpoint as C
 
         ckpt_dir = kw.get("ckpt_dir")
-        if not (ckpt_dir and os.path.exists(
-                os.path.join(ckpt_dir, "params.npz"))):
+        if not (ckpt_dir and C.has_checkpoint(ckpt_dir)):
             raise RuntimeError(
                 f"host {e.host} lost at step {e.step}, but no "
                 f"checkpoint exists under {ckpt_dir!r} to heal from "
                 "(ckpt_every never fired?)"
             ) from e
-        with open(os.path.join(ckpt_dir, C._META)) as fh:
-            resume_step = json.load(fh).get("step", 0)
+        # The heal reshards whatever the VERIFYING ladder would land
+        # on — a rotted newest generation falls back to the newest
+        # intact one, composing storage damage with host loss
+        # (docs/checkpoint_durability.md).
+        resume_step = C.latest_intact_step(ckpt_dir)
+        if resume_step is None:
+            raise RuntimeError(
+                f"host {e.host} lost at step {e.step}, but no INTACT "
+                f"generation survives under {ckpt_dir!r} to heal from"
+            ) from e
         devices = [d for i, d in enumerate(mesh.devices.flat)
                    if i != e.host]
         m = 1
@@ -575,6 +632,103 @@ def run_training_with_heal(mesh, cfg, *, steps: int,
         return out
 
 
+def run_training_supervised(mesh, cfg, *, steps: int,
+                            fault_plan=None, resume: bool = False,
+                            max_restarts: int = 3, **kw) -> dict:
+    """:func:`run_training` wrapped in the crash-resilient supervisor
+    (docs/checkpoint_durability.md; ``python -m tpu_p2p.train
+    --supervise``).
+
+    A (simulated) process death mid-checkpoint-write
+    (:class:`tpu_p2p.obs.faults.SimulatedCrash` — a ``BaseException``
+    no ordinary error handling can swallow) is caught here, and the
+    loop re-enters from the newest INTACT generation via the
+    verifying loader (the atomic publish guarantees the crash left
+    either no new generation or a complete one). The deterministic
+    per-step batch stream then replays exactly the steps the crash
+    destroyed, so an interrupted-at-any-point run reproduces the
+    uninterrupted run's loss trajectory bit for bit (the ckpt-chaos
+    smoke grades it). Requires ``ckpt_dir`` + ``ckpt_every``; at most
+    ``max_restarts`` re-entries (a crash loop must fail loudly, not
+    spin). The returned summary carries a ``supervisor`` dict
+    (``restarts`` + per-crash ``step``/``resume_step``/
+    ``lost_steps``); crash → resume transitions print ``# supervise:``
+    lines on ``log_stream`` and ride the obs stream as
+    ``{"obs": "ckpt", "event": "crash_restart"}`` records that ``obs
+    watch`` alerts on.
+    """
+    from tpu_p2p.obs import faults as _faults_mod
+    from tpu_p2p.utils import checkpoint as C
+
+    ckpt_dir = kw.get("ckpt_dir")
+    if not (ckpt_dir and kw.get("ckpt_every")):
+        raise ValueError(
+            "supervised training needs ckpt_dir and ckpt_every — "
+            "without a generation to re-enter from, a crash is total "
+            "loss"
+        )
+    if max_restarts < 1:
+        raise ValueError(f"max_restarts must be >= 1, got "
+                         f"{max_restarts}")
+    kw = dict(kw)
+    kw.pop("heal", None)  # the wrappers are mutually exclusive
+    log_stream = kw.get("log_stream")
+    obs_jsonl = kw.get("obs_jsonl")
+
+    def note(msg):
+        if log_stream is not None:
+            print(msg, file=log_stream, flush=True)
+
+    def emit_obs(rec):
+        if obs_jsonl:
+            with open(obs_jsonl, "a") as fh:
+                fh.write(json.dumps(rec) + "\n")
+
+    restarts = 0
+    crashes = []
+    while True:
+        try:
+            out = run_training(mesh, cfg, steps=steps,
+                               resume=resume or restarts > 0,
+                               fault_plan=fault_plan, **kw)
+            out["supervisor"] = {"restarts": restarts,
+                                 "crashes": crashes}
+            if restarts:
+                note(f"# supervise: completed at step {steps} after "
+                     f"{restarts} restart(s)")
+            return out
+        except _faults_mod.SimulatedCrash as e:
+            restarts += 1
+            crash_step = e.step
+            intact = C.latest_intact_step(ckpt_dir)
+            resume_step = intact if intact is not None else 0
+            lost = (crash_step - resume_step
+                    if crash_step is not None else None)
+            crashes.append({"step": crash_step,
+                            "resume_step": resume_step,
+                            "lost_steps": lost})
+            # Deterministic transcript (the temp-dir path in the
+            # exception would break the golden pin): file basename +
+            # byte count only.
+            note(f"# supervise: crashed mid-checkpoint at step "
+                 f"{crash_step} (simulated process death after "
+                 f"{e.bytes_written} bytes into "
+                 f"{os.path.basename(e.path)})")
+            if intact is not None:
+                note(f"# supervise: resuming from gen-{intact:06d} "
+                     f"(step {resume_step}, {lost} step(s) to re-run)")
+            else:
+                note("# supervise: no intact generation — restarting "
+                     f"from step 0 ({lost} step(s) to re-run)")
+            emit_obs({"obs": "ckpt", "event": "crash_restart",
+                      "step": crash_step, "resume_step": resume_step,
+                      "restarts": restarts, "ok": False})
+            if restarts > max_restarts:
+                note(f"# supervise: restart budget ({max_restarts}) "
+                     "exhausted — giving up")
+                raise
+
+
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tpu_p2p.train",
@@ -593,8 +747,26 @@ def _build_parser() -> argparse.ArgumentParser:
                         "obs_step_ms_p50 summary; syncs every step")
     p.add_argument("--ckpt-dir", default=None, metavar="DIR")
     p.add_argument("--ckpt-every", type=int, default=0, metavar="N")
+    from tpu_p2p.config import CKPT_KEEP
+
+    p.add_argument("--ckpt-keep", type=int, default=CKPT_KEEP,
+                   metavar="K",
+                   help="checkpoint generations retained after each "
+                        "atomic publish "
+                        "(docs/checkpoint_durability.md)")
     p.add_argument("--resume", action="store_true",
-                   help="continue from the checkpoint in --ckpt-dir")
+                   help="continue from the newest INTACT checkpoint "
+                        "generation in --ckpt-dir (the verifying "
+                        "loader falls back past damaged ones)")
+    p.add_argument("--supervise", action="store_true",
+                   help="crash-resilient supervisor: a (simulated) "
+                        "process death mid-checkpoint re-enters from "
+                        "the newest intact generation and replays the "
+                        "lost steps deterministically (requires "
+                        "--ckpt-dir and --ckpt-every)")
+    p.add_argument("--max-restarts", type=int, default=3, metavar="N",
+                   help="--supervise: crash re-entries before giving "
+                        "up")
     p.add_argument("--optimizer", default="sgd", choices=("sgd", "adamw"))
     p.add_argument("--weight-decay", type=float, default=0.0)
     p.add_argument("--clip-norm", type=float, default=0.0,
@@ -627,7 +799,25 @@ def _build_parser() -> argparse.ArgumentParser:
                    metavar="H", help="inject: host H stops "
                                      "heartbeating")
     p.add_argument("--fault-at-step", type=int, default=0, metavar="K",
-                   help="first step the slow/lost fault applies to")
+                   help="first step the slow/lost/ckpt fault applies "
+                        "to")
+    # Storage faults (round 17, docs/checkpoint_durability.md) — the
+    # ckpt-chaos scenarios, applied only by the interposed writer in
+    # utils/checkpoint.py:
+    p.add_argument("--fault-ckpt-crash-bytes", type=int, default=None,
+                   metavar="B",
+                   help="inject: simulated process death after B "
+                        "bytes of the first checkpoint save at/past "
+                        "--fault-at-step (pair with --supervise)")
+    p.add_argument("--fault-ckpt-corrupt-seed", type=int, default=None,
+                   metavar="S",
+                   help="inject: seeded one-bit flip in each "
+                        "generation published at/past --fault-at-step")
+    p.add_argument("--fault-ckpt-io-errors", type=int, default=0,
+                   metavar="N",
+                   help="inject: first N checkpoint write attempts "
+                        "fail transiently (absorbed by the bounded "
+                        "retry)")
     # Model shape (FlagshipConfig fields).
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=256)
@@ -726,7 +916,10 @@ def main(argv=None) -> int:
     )
     fault_plan = None
     if (args.fault_degrade_edge or args.fault_slow_rank is not None
-            or args.fault_lost_host is not None):
+            or args.fault_lost_host is not None
+            or args.fault_ckpt_crash_bytes is not None
+            or args.fault_ckpt_corrupt_seed is not None
+            or args.fault_ckpt_io_errors):
         from tpu_p2p.config import parse_edge
         from tpu_p2p.obs.faults import FaultPlan
 
@@ -737,12 +930,15 @@ def main(argv=None) -> int:
             slow_rank=args.fault_slow_rank,
             slow_ms=args.fault_slow_ms,
             lost_host=args.fault_lost_host,
+            ckpt_crash_after_bytes=args.fault_ckpt_crash_bytes,
+            ckpt_corrupt_seed=args.fault_ckpt_corrupt_seed,
+            ckpt_io_errors=args.fault_ckpt_io_errors,
             start_step=args.fault_at_step,
         )
     common = dict(
         steps=args.steps, lr=args.lr, seed=args.seed,
         log_every=args.log_every, ckpt_dir=args.ckpt_dir,
-        ckpt_every=args.ckpt_every,
+        ckpt_every=args.ckpt_every, ckpt_keep=args.ckpt_keep,
         log_path=args.log_jsonl, log_stream=sys.stdout,
         optimizer=args.optimizer, weight_decay=args.weight_decay,
         eval_every=args.eval_every, eval_batches=args.eval_batches,
@@ -750,7 +946,16 @@ def main(argv=None) -> int:
         schedule=args.schedule, obs_jsonl=args.obs_jsonl,
         fault_plan=fault_plan,
     )
-    if args.heal:
+    if args.supervise and args.heal:
+        raise SystemExit(
+            "--supervise and --heal are separate recovery wrappers; "
+            "pick one (the supervisor covers storage crashes, heal "
+            "covers lost hosts)")
+    if args.supervise:
+        summary = run_training_supervised(
+            mesh, cfg, resume=args.resume,
+            max_restarts=args.max_restarts, **common)
+    elif args.heal:
         summary = run_training_with_heal(mesh, cfg,
                                          resume=args.resume, **common)
     else:

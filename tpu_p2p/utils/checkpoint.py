@@ -1,4 +1,4 @@
-"""Checkpoint / resume for model state.
+"""Checkpoint / resume for model state — durable, multi-generation.
 
 SURVEY.md §5 "checkpoint / resume": the reference has none (its whole
 sweep just reruns, ``p2p_matrix.cc`` start to finish). The benchmark
@@ -7,19 +7,64 @@ twin of the stdout matrix (:mod:`tpu_p2p.utils.report`); this module
 adds the *model* side so training workloads (flagship / pipeline /
 ring transformer) can save and restore sharded params.
 
+Round 17 made the model side DURABLE (docs/checkpoint_durability.md).
+The original layout — one rolling ``params.npz`` + meta overwritten
+in place — is exactly the storage failure mode MegaScale (Jiang et
+al., 2024) reports dominating real large-run downtime: a crash
+mid-``np.savez`` leaves a truncated npz beside a stale-or-new meta
+and the run is unrecoverable. The durable layout is generational:
+
+- :func:`save_generation` writes a complete ``gen-<step>/`` (params,
+  optional optimizer state + schedule metadata, and a ``MANIFEST.json``
+  carrying per-file AND per-array sha256 checksums + byte sizes) into
+  a temp dir, fsyncs every file and the directory, then publishes it
+  with a single ``os.rename`` — a generation either exists completely
+  or not at all. A ``LATEST`` pointer file is updated (write-temp +
+  rename) only *after* publish, and the last K generations are
+  retained (``keep``, default :data:`tpu_p2p.config.CKPT_KEEP`).
+- :func:`load_latest` is the verifying loader: it walks generations
+  newest-first, re-checking sizes and checksums
+  (:func:`verify_generation` names the damage — torn manifest,
+  truncated file, checksum mismatch, missing array, empty dir), and
+  falls back generation by generation to the newest intact one,
+  reporting what it skipped and why. ``train.py --resume`` /
+  ``--heal`` / ``--supervise`` all route through it.
+- Every generation file goes through an interposed writer that (a)
+  retries transient ``OSError`` with bounded exponential backoff
+  (:func:`tpu_p2p.utils.retry.retry_io`) and (b) applies the
+  round-17 storage faults (:mod:`tpu_p2p.obs.faults`:
+  ``ckpt_crash_after_bytes`` / ``ckpt_io_errors`` /
+  ``ckpt_corrupt_seed``) — this module is on the fault grep-lint
+  allowlist (tests/test_no_raw_collectives.py) as the ONLY storage
+  application site.
+
+The legacy flat layout (``params.npz`` + meta directly under the
+directory) is still readable — :func:`load_latest` falls back to it
+when no generation exists — and :func:`save_params` now records
+per-array checksums in its meta so a torn flat pair (a crash between
+the npz and meta writes leaving a new npz under an old meta, or vice
+versa) is *detected* instead of silently loaded.
+
 Design: orbax-checkpoint when available (the idiomatic JAX answer —
-async-capable, multi-host aware), with a plain ``.npz`` fallback that
-has zero extra dependencies. Both paths round-trip arbitrary flat
-``dict[str, Array]`` pytrees and re-place them onto a target mesh via
-``NamedSharding``, so a checkpoint written under one mesh shape can be
-restored under another (the resharding is a ``device_put``).
+async-capable, multi-host aware), with the plain ``.npz`` layouts as
+the zero-extra-dependency default. All paths round-trip arbitrary
+flat ``dict[str, Array]`` pytrees and re-place them onto a target
+mesh via ``NamedSharding``, so a checkpoint written under one mesh
+shape can be restored under another (the resharding is a
+``device_put``).
 """
 
 from __future__ import annotations
 
+import hashlib
+import io
 import json
 import os
-from typing import Dict, Optional
+import re
+import shutil
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -28,25 +73,226 @@ from jax.sharding import Mesh, NamedSharding
 Params = Dict[str, jax.Array]
 
 _META = "tpu_p2p_checkpoint.json"
+_OPT_META = "tpu_p2p_opt_state.json"
+_SCHED_META = "train_schedule.json"
+MANIFEST = "MANIFEST.json"
+LATEST = "LATEST"
+_GEN_FORMAT = "tpu-p2p-gen-1"
+_GEN_RE = re.compile(r"^gen-(\d{6,})$")
+
+
+def _gen_name(step: int) -> str:
+    return f"gen-{int(step):06d}"
+
+
+def _digest(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _array_digest(a) -> str:
+    return _digest(np.ascontiguousarray(a).tobytes())
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _npz_bytes(arrays: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+# ----------------------------------------------- interposed IO writer
+# Every generation file lands through _write_file: one choke point
+# for fsync discipline, bounded retry, and the round-17 storage
+# faults. Consulting faults.active_plan() here (and ONLY here, plus
+# obs/faults.py itself) is pinned by the fault grep-lint.
+
+
+def _io_session(step: int) -> dict:
+    from tpu_p2p.obs import faults
+
+    plan = faults.active_plan()
+    return {
+        "plan": plan,
+        "step": int(step),
+        "crash_budget": faults.ckpt_crash_budget(plan, step),
+        "retries": 0,
+        "bytes": 0,
+    }
+
+
+def _write_file(session: dict, path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` (flush + fsync), under the active
+    fault plan's storage faults, retrying transient OSError with
+    bounded exponential backoff."""
+    from tpu_p2p.obs import faults
+    from tpu_p2p.utils.retry import retry_io
+
+    plan = session["plan"]
+
+    def attempt():
+        if faults.take_ckpt_io_error(plan):
+            raise OSError(
+                f"injected transient IO error writing {path} "
+                "(FaultPlan.ckpt_io_errors)")
+        budget = session["crash_budget"]
+        with open(path, "wb") as fh:
+            if budget is not None and len(data) > budget:
+                fh.write(data[:budget])
+                fh.flush()
+                os.fsync(fh.fileno())
+                faults.mark_ckpt_crash_fired(plan)
+                crash = faults.SimulatedCrash(path, budget)
+                crash.step = session["step"]
+                raise crash
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        if budget is not None:
+            session["crash_budget"] = budget - len(data)
+        session["bytes"] += len(data)
+
+    def count(_attempt, _exc):
+        session["retries"] += 1
+
+    retry_io(attempt, on_retry=count)
+
+
+def _maybe_corrupt_published(session: dict, gen_dir: str) -> bool:
+    """Apply the seeded published-generation bit flip
+    (``FaultPlan.ckpt_corrupt_seed``) — the deterministic stand-in
+    for at-rest rot, applied AFTER the atomic publish so the loader's
+    checksum fallback (not the publish protocol) is what it tests."""
+    from tpu_p2p.obs import faults
+
+    plan = session["plan"]
+    if not faults.ckpt_corrupt_due(plan, session["step"]):
+        return False
+    fp = os.path.join(gen_dir, "params.npz")
+    with open(fp, "rb") as fh:
+        data = bytearray(fh.read())
+    rng = np.random.default_rng(plan.ckpt_corrupt_seed)
+    off = int(rng.integers(0, len(data)))
+    data[off] ^= 1 << int(rng.integers(0, 8))
+    with open(fp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    return True
+
+
+# ------------------------------------------------- payload assembly
+
+
+def _params_payload(params: Params, step: int):
+    """→ (npz_bytes, meta_dict, array_records) for a params dict."""
+    arrays = {k: np.asarray(v) for k, v in params.items()}
+    meta = {
+        "step": int(step), "keys": sorted(arrays),
+        "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
+        # Per-array integrity: a torn npz/meta pair (or any bit rot)
+        # must be detected, not loaded (round-17 satellite).
+        "sha256": {k: _array_digest(v) for k, v in arrays.items()},
+    }
+    records = {
+        k: {"sha256": meta["sha256"][k], "bytes": int(v.nbytes),
+            "dtype": str(v.dtype), "shape": list(v.shape)}
+        for k, v in arrays.items()
+    }
+    return _npz_bytes(arrays), meta, records
+
+
+def _opt_payload(opt_state, step: int):
+    """Flatten an optimizer-state pytree into the positional npz
+    layout + its pairing-fingerprint meta (the structure contract
+    :func:`load_opt_state` validates)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(opt_state)
+    leaves = [np.asarray(v) for _, v in flat]
+    arrays = {f"l{i}": v for i, v in enumerate(leaves)}
+    meta = {
+        "step": int(step), "count": len(leaves),
+        # Pairing fingerprint: leaves are stored positionally, so
+        # two same-shaped leaves swapped by a different optax
+        # version's tree order (mu vs nu) would otherwise restore
+        # silently mis-paired. Per-leaf key paths name exactly
+        # which slot each array came from (and unlike the full
+        # PyTreeDef repr they don't encode node internals whose
+        # rendering shifts across JAX versions).
+        "leaf_paths": [jax.tree_util.keystr(kp) for kp, _ in flat],
+        "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
+        "shapes": {k: list(v.shape) for k, v in arrays.items()},
+    }
+    records = {
+        k: {"sha256": _array_digest(v), "bytes": int(v.nbytes),
+            "dtype": str(v.dtype), "shape": list(v.shape)}
+        for k, v in arrays.items()
+    }
+    return _npz_bytes(arrays), meta, records
+
+
+# -------------------------------------------------- flat (legacy) API
 
 
 def save_params(path: str, params: Params, step: int = 0) -> str:
-    """Write ``params`` (+ step metadata) under directory ``path``.
+    """Write ``params`` (+ step metadata) flat under directory
+    ``path`` — the legacy single-checkpoint layout.
 
-    Host-gathers each leaf (``np.asarray``) and writes one ``.npz`` —
-    simple, dependency-free, and correct for single-process use; the
-    orbax path (:func:`save_params_orbax`) covers multi-host.
+    Host-gathers each leaf (``np.asarray``) and writes one ``.npz``.
+    The meta now carries per-array sha256 checksums, so a pair torn
+    by a crash between the two writes is detected at load; for
+    atomic multi-generation durability use :func:`save_generation`
+    (the training loop does).
     """
     os.makedirs(path, exist_ok=True)
-    arrays = {k: np.asarray(v) for k, v in params.items()}
-    np.savez(os.path.join(path, "params.npz"), **arrays)
+    npz, meta, _records = _params_payload(params, step)
+    with open(os.path.join(path, "params.npz"), "wb") as fh:
+        fh.write(npz)
     with open(os.path.join(path, _META), "w") as fh:
-        json.dump(
-            {"step": step, "keys": sorted(arrays),
-             "dtypes": {k: str(v.dtype) for k, v in arrays.items()}},
-            fh,
-        )
+        json.dump(meta, fh)
     return path
+
+
+def _load_flat_params(path: str) -> Tuple[Dict[str, np.ndarray], int]:
+    """The verifying flat-layout reader shared by :func:`load_params`
+    and the generation loader (a published generation's interior IS
+    the flat layout plus a manifest)."""
+    import ml_dtypes  # noqa: F401 — registers bfloat16 etc. with numpy
+
+    with open(os.path.join(path, _META)) as fh:
+        meta = json.load(fh)
+    with np.load(os.path.join(path, "params.npz")) as z:
+        arrays = {k: z[k] for k in z.files}
+    if set(arrays) != set(meta["keys"]):
+        raise ValueError(
+            f"checkpoint at {path} is torn: meta lists {meta['keys']}, "
+            f"npz holds {sorted(arrays)}"
+        )
+    # Checksums verify on the RAW stored bytes (extension dtypes land
+    # as void views; the bytes are dtype-independent), before the
+    # dtype re-view below. Pre-round-17 metas lack the key and are
+    # trusted as before.
+    for k, want in meta.get("sha256", {}).items():
+        if k not in arrays:
+            continue  # key-set tears are already caught above
+        got = _array_digest(arrays[k])
+        if got != want:
+            raise ValueError(
+                f"checkpoint at {path} is torn: array {k!r} checksum "
+                f"mismatch (npz and meta were written by different "
+                "saves, or the file rotted at rest)"
+            )
+    # npz stores extension dtypes (bfloat16, fp8) as raw void bytes;
+    # re-view them through the dtype recorded at save time.
+    for k, want in meta.get("dtypes", {}).items():
+        if k in arrays and str(arrays[k].dtype) != want:
+            arrays[k] = arrays[k].view(np.dtype(want))
+    return arrays, meta.get("step", 0)
 
 
 def load_params(path: str, mesh: Optional[Mesh] = None,
@@ -56,23 +302,18 @@ def load_params(path: str, mesh: Optional[Mesh] = None,
     ``specs``: ``{name: PartitionSpec}`` as produced by the model's
     ``*_param_specs(mesh)`` — restoring under a different mesh shape
     than the save is fine; placement is just a ``device_put``.
-    """
-    import ml_dtypes  # noqa: F401 — registers bfloat16 etc. with numpy
 
-    with open(os.path.join(path, _META)) as fh:
-        meta = json.load(fh)
-    with np.load(os.path.join(path, "params.npz")) as z:
-        arrays = {k: z[k] for k in z.files}
-    # npz stores extension dtypes (bfloat16, fp8) as raw void bytes;
-    # re-view them through the dtype recorded at save time.
-    for k, want in meta.get("dtypes", {}).items():
-        if k in arrays and str(arrays[k].dtype) != want:
-            arrays[k] = arrays[k].view(np.dtype(want))
-    if set(arrays) != set(meta["keys"]):
-        raise ValueError(
-            f"checkpoint at {path} is torn: meta lists {meta['keys']}, "
-            f"npz holds {sorted(arrays)}"
-        )
+    When ``path`` holds generations, this routes through the
+    verifying ladder (:func:`load_latest`) — the newest INTACT
+    generation is what loads, corrupt ones are skipped. A flat legacy
+    layout reads directly (with checksum verification when the meta
+    carries checksums).
+    """
+    if list_generations(path):
+        lc = load_latest(path)
+        arrays, step = lc.params, lc.step
+    else:
+        arrays, step = _load_flat_params(path)
     if mesh is not None and specs is not None:
         params = {
             k: jax.device_put(v, NamedSharding(mesh, specs[k]))
@@ -80,14 +321,13 @@ def load_params(path: str, mesh: Optional[Mesh] = None,
         }
     else:
         params = {k: jax.numpy.asarray(v) for k, v in arrays.items()}
-    return params, meta.get("step", 0)
-
-
-_OPT_META = "tpu_p2p_opt_state.json"
+    return params, step
 
 
 def save_opt_state(path: str, opt_state, step: int = 0) -> str:
-    """Write an optimizer-state pytree (any structure) under ``path``.
+    """Write an optimizer-state pytree (any structure) under ``path``
+    — the legacy flat layout (:func:`save_generation` folds the same
+    files into the atomic generation publish instead).
 
     Leaves are host-gathered and stored positionally (flatten order);
     :func:`load_opt_state` restores them into a freshly-initialized
@@ -95,32 +335,20 @@ def save_opt_state(path: str, opt_state, step: int = 0) -> str:
     same contract as params resume (same config ⇒ same tree).
     """
     os.makedirs(path, exist_ok=True)
-    flat, _ = jax.tree_util.tree_flatten_with_path(opt_state)
-    leaves = [v for _, v in flat]
-    arrays = {f"l{i}": np.asarray(v) for i, v in enumerate(leaves)}
-    np.savez(os.path.join(path, "opt_state.npz"), **arrays)
+    npz, meta, _records = _opt_payload(opt_state, step)
+    with open(os.path.join(path, "opt_state.npz"), "wb") as fh:
+        fh.write(npz)
     with open(os.path.join(path, _OPT_META), "w") as fh:
-        json.dump(
-            {"step": step, "count": len(leaves),
-             # Pairing fingerprint: leaves are stored positionally, so
-             # two same-shaped leaves swapped by a different optax
-             # version's tree order (mu vs nu) would otherwise restore
-             # silently mis-paired. Per-leaf key paths name exactly
-             # which slot each array came from (and unlike the full
-             # PyTreeDef repr they don't encode node internals whose
-             # rendering shifts across JAX versions).
-             "leaf_paths": [jax.tree_util.keystr(kp) for kp, _ in flat],
-             "dtypes": {k: str(v.dtype) for k, v in arrays.items()},
-             "shapes": {k: list(v.shape) for k, v in arrays.items()}},
-            fh,
-        )
+        json.dump(meta, fh)
     return path
 
 
 def clear_opt_state(path: str) -> None:
     """Remove any optimizer-state files under ``path`` — the plain-sgd
     save path calls this so overwriting a rolling checkpoint dir never
-    leaves a stale ``opt_state.npz`` paired with newer params."""
+    leaves a stale ``opt_state.npz`` paired with newer params. (The
+    generation layout needs no such sweep: each ``gen-<step>/`` is
+    self-contained, published atomically with or without opt files.)"""
     for name in ("opt_state.npz", _OPT_META):
         fp = os.path.join(path, name)
         if os.path.exists(fp):
@@ -128,14 +356,17 @@ def clear_opt_state(path: str) -> None:
 
 
 def load_opt_state(path: str, template, expect_step: Optional[int] = None):
-    """Restore an optimizer state saved by :func:`save_opt_state` into
-    ``template``'s structure and placements (``template`` = the state
+    """Restore an optimizer state saved by :func:`save_opt_state` (or
+    inside a generation dir — same files) into ``template``'s
+    structure and placements (``template`` = the state
     ``init_optimizer`` builds for the *same* optimizer and params).
 
-    ``expect_step``: the params checkpoint's step — params and
-    optimizer state are separate files, so a crash between the two
-    saves (or a dir reused across optimizers) can leave a stale
-    pairing; the recorded step makes that detectable."""
+    ``expect_step``: the params checkpoint's step — in the legacy
+    flat layout params and optimizer state are separate files, so a
+    crash between the two saves (or a dir reused across optimizers)
+    can leave a stale pairing; the recorded step makes that
+    detectable. (Generations publish both atomically, so a mismatch
+    there means a damaged manifest — also refused.)"""
     import ml_dtypes  # noqa: F401 — registers bfloat16 etc. with numpy
 
     with open(os.path.join(path, _OPT_META)) as fh:
@@ -184,6 +415,277 @@ def load_opt_state(path: str, template, expect_step: Optional[int] = None):
         out.append(jax.device_put(a, sharding) if sharding is not None
                    else jax.numpy.asarray(a))
     return jax.tree.unflatten(treedef, out)
+
+
+# ------------------------------------------- durable generation layout
+
+
+def list_generations(path: str) -> List[Tuple[int, str]]:
+    """Published generations under ``path``, NEWEST FIRST, as
+    ``(step, name)`` pairs — the fallback ladder's walk order. Only
+    fully-renamed ``gen-<step>`` directories count; temp dirs from a
+    crashed save never appear."""
+    out: List[Tuple[int, str]] = []
+    if not os.path.isdir(path):
+        return out
+    for name in os.listdir(path):
+        m = _GEN_RE.match(name)
+        if m and os.path.isdir(os.path.join(path, name)):
+            out.append((int(m.group(1)), name))
+    out.sort(reverse=True)
+    return out
+
+
+def has_checkpoint(path: Optional[str]) -> bool:
+    """Is there anything restorable under ``path`` — a published
+    generation or a legacy flat ``params.npz``? (Existence, not
+    integrity: :func:`load_latest` judges intactness.)"""
+    if not path:
+        return False
+    if list_generations(path):
+        return True
+    return os.path.exists(os.path.join(path, "params.npz"))
+
+
+def read_latest_pointer(path: str) -> Optional[str]:
+    """The ``LATEST`` pointer's generation name, or None. Updated
+    only after a publish completes, so it always names a generation
+    that finished its atomic rename — but the loader treats it as a
+    hint and walks the full ladder regardless (a crash between
+    publish and pointer update leaves a newer intact generation the
+    pointer has not caught up to)."""
+    fp = os.path.join(path, LATEST)
+    try:
+        with open(fp) as fh:
+            name = fh.read().strip()
+    except OSError:
+        return None
+    return name or None
+
+
+def verify_generation(gen_dir: str) -> Optional[str]:
+    """Integrity-check one published generation; → None when intact,
+    else a reason string naming the damage (the fallback report's
+    vocabulary: empty dir, missing/torn manifest, missing file,
+    truncation, file/array checksum mismatch, missing array)."""
+    if not os.path.isdir(gen_dir):
+        return "missing generation dir"
+    if not os.listdir(gen_dir):
+        return "empty generation dir"
+    mf = os.path.join(gen_dir, MANIFEST)
+    if not os.path.exists(mf):
+        return "missing manifest"
+    try:
+        with open(mf) as fh:
+            manifest = json.load(fh)
+    except (json.JSONDecodeError, OSError) as e:
+        return f"torn manifest ({type(e).__name__})"
+    if (manifest.get("format") != _GEN_FORMAT
+            or not isinstance(manifest.get("files"), dict)
+            or "step" not in manifest):
+        return "torn manifest (wrong format/keys)"
+    for fname, rec in sorted(manifest["files"].items()):
+        fp = os.path.join(gen_dir, fname)
+        if not os.path.exists(fp):
+            return f"missing file {fname}"
+        size = os.path.getsize(fp)
+        if size != rec.get("bytes"):
+            return (f"truncated {fname}: {size} of "
+                    f"{rec.get('bytes')} bytes")
+        with open(fp, "rb") as fh:
+            if _digest(fh.read()) != rec.get("sha256"):
+                return f"checksum mismatch in {fname}"
+    for fname, want in sorted(manifest.get("arrays", {}).items()):
+        fp = os.path.join(gen_dir, fname)
+        try:
+            with np.load(fp) as z:
+                arrays = {k: z[k] for k in z.files}
+        except Exception as e:  # noqa: BLE001 — any unreadable npz is
+            # the same verdict: this generation cannot be trusted.
+            return f"unreadable {fname} ({type(e).__name__})"
+        missing = set(want) - set(arrays)
+        if missing:
+            return f"missing array {sorted(missing)[0]!r} in {fname}"
+        extra = set(arrays) - set(want)
+        if extra:
+            return f"unexpected array {sorted(extra)[0]!r} in {fname}"
+        for k, rec in sorted(want.items()):
+            if _array_digest(arrays[k]) != rec.get("sha256"):
+                return f"array checksum mismatch: {k!r} in {fname}"
+    return None
+
+
+@dataclass
+class LoadedCheckpoint:
+    """What the verifying loader found: the generation (or legacy
+    flat dir) it settled on, host-side params, and the ladder of
+    generations it skipped with the reason each was rejected."""
+
+    path: str                 # the dir the params came from
+    name: Optional[str]       # gen-XXXXXX, or None for legacy flat
+    step: int
+    params: Dict[str, np.ndarray]
+    skipped: List[dict] = field(default_factory=list)
+
+
+def load_latest(path: str) -> LoadedCheckpoint:
+    """The verifying loader: walk generations newest-first, verify
+    each (:func:`verify_generation`), and return the newest INTACT
+    one — falling back to the legacy flat layout when no generation
+    exists. Raises ``ValueError`` (listing every skipped generation
+    and why) when nothing restorable survives."""
+    skipped: List[dict] = []
+    for _step, name in list_generations(path):
+        gd = os.path.join(path, name)
+        reason = verify_generation(gd)
+        if reason is not None:
+            skipped.append({"generation": name, "reason": reason})
+            continue
+        arrays, step = _load_flat_params(gd)
+        return LoadedCheckpoint(path=gd, name=name, step=step,
+                                params=arrays, skipped=skipped)
+    if os.path.exists(os.path.join(path, "params.npz")):
+        arrays, step = _load_flat_params(path)
+        return LoadedCheckpoint(path=path, name=None, step=step,
+                                params=arrays, skipped=skipped)
+    detail = "; ".join(f"{s['generation']}: {s['reason']}"
+                       for s in skipped) or "no generations, no flat layout"
+    raise ValueError(
+        f"no intact checkpoint under {path} ({detail})"
+    )
+
+
+def latest_intact_step(path: str) -> Optional[int]:
+    """Step of the newest generation that verifies (legacy flat step
+    when no generation exists), or None — the heal/supervisor paths'
+    answer to "where would a resume land?" without loading params
+    twice on failure."""
+    for step, name in list_generations(path):
+        if verify_generation(os.path.join(path, name)) is None:
+            return step
+    meta = os.path.join(path, _META)
+    if os.path.exists(meta) and os.path.exists(
+            os.path.join(path, "params.npz")):
+        try:
+            with open(meta) as fh:
+                return int(json.load(fh).get("step", 0))
+        except (json.JSONDecodeError, OSError, ValueError):
+            return None
+    return None
+
+
+def save_generation(path: str, params: Params, step: int, *,
+                    opt_state=None, sched_meta: Optional[dict] = None,
+                    keep: Optional[int] = None) -> dict:
+    """Atomically publish ``gen-<step>/`` under ``path``.
+
+    Protocol (docs/checkpoint_durability.md): every file — params.npz,
+    its meta, optional opt_state.npz + meta + schedule metadata, and
+    the MANIFEST with per-file and per-array sha256 + byte sizes — is
+    written into a hidden temp dir through the interposed fault/retry
+    writer with flush+fsync, the temp dir is fsynced, ONE
+    ``os.rename`` publishes it, the parent dir is fsynced, and only
+    then is the ``LATEST`` pointer updated (write-temp + rename) and
+    retention pruned to the newest ``keep`` generations (default
+    :data:`tpu_p2p.config.CKPT_KEEP`). A crash at ANY byte leaves
+    either no new generation (temp dirs are swept by the next save
+    and never parse as generations) or a complete, verifiable one.
+
+    Params and optimizer state publish in the SAME generation — the
+    torn params@N/opt@N-1 pairing the two-file legacy save could
+    produce cannot exist here.
+
+    → a stats dict: ``path``/``name``/``step``/``bytes`` written,
+    ``write_retries`` absorbed, ``corrupted`` (the injected rot
+    fault, when it fired) and ``pruned`` generation names.
+    """
+    if keep is None:
+        from tpu_p2p.config import CKPT_KEEP
+
+        keep = CKPT_KEEP
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    os.makedirs(path, exist_ok=True)
+    # Sweep leftovers from crashed saves (single-writer contract: one
+    # training process owns a checkpoint dir).
+    for name in os.listdir(path):
+        if name.startswith((".tmp-gen-", ".stale-gen-")):
+            shutil.rmtree(os.path.join(path, name), ignore_errors=True)
+
+    session = _io_session(step)
+    name = _gen_name(step)
+    tmp = os.path.join(path, f".tmp-gen-{uuid.uuid4().hex[:8]}")
+    os.makedirs(tmp)
+
+    files: Dict[str, bytes] = {}
+    arrays_manifest: Dict[str, dict] = {}
+    npz, meta, records = _params_payload(params, step)
+    files["params.npz"] = npz
+    files[_META] = json.dumps(meta).encode()
+    arrays_manifest["params.npz"] = records
+    if opt_state is not None:
+        onpz, ometa, orecords = _opt_payload(opt_state, step)
+        files["opt_state.npz"] = onpz
+        files[_OPT_META] = json.dumps(ometa).encode()
+        arrays_manifest["opt_state.npz"] = orecords
+    if sched_meta is not None:
+        files[_SCHED_META] = json.dumps(sched_meta).encode()
+    manifest = {
+        "format": _GEN_FORMAT,
+        "step": int(step),
+        "files": {fname: {"sha256": _digest(data),
+                          "bytes": len(data)}
+                  for fname, data in files.items()},
+        "arrays": arrays_manifest,
+    }
+    # The manifest covers every sibling file (it cannot list itself;
+    # a torn manifest is caught by its own JSON parse + format keys).
+    files[MANIFEST] = json.dumps(manifest, indent=1).encode()
+
+    for fname in ("params.npz", _META, "opt_state.npz", _OPT_META,
+                  _SCHED_META, MANIFEST):
+        if fname in files:
+            _write_file(session, os.path.join(tmp, fname),
+                        files[fname])
+    _fsync_dir(tmp)
+
+    final = os.path.join(path, name)
+    if os.path.exists(final):
+        # Republishing a step (e.g. a resumed run re-reaching a save
+        # point whose generation rotted): move the stale dir aside so
+        # the rename stays atomic, then drop it.
+        aside = os.path.join(path,
+                             f".stale-gen-{uuid.uuid4().hex[:8]}")
+        os.rename(final, aside)
+        os.rename(tmp, final)
+        shutil.rmtree(aside, ignore_errors=True)
+    else:
+        os.rename(tmp, final)
+    _fsync_dir(path)
+
+    # LATEST is updated ONLY after the publish rename — through the
+    # same interposed writer, so a crash budget spanning the pointer
+    # update leaves the previous pointer intact (and the loader walks
+    # the ladder regardless).
+    latest_tmp = os.path.join(path, LATEST + ".tmp")
+    _write_file(session, latest_tmp, (name + "\n").encode())
+    os.replace(latest_tmp, os.path.join(path, LATEST))
+    _fsync_dir(path)
+
+    corrupted = _maybe_corrupt_published(session, final)
+
+    pruned: List[str] = []
+    for _s, old in list_generations(path)[keep:]:
+        shutil.rmtree(os.path.join(path, old), ignore_errors=True)
+        pruned.append(old)
+
+    return {"path": final, "name": name, "step": int(step),
+            "bytes": session["bytes"],
+            "write_retries": session["retries"],
+            "corrupted": corrupted, "pruned": pruned}
+
+
+# ----------------------------------------------------------- orbax
 
 
 def save_params_orbax(path: str, params: Params, step: int = 0) -> str:

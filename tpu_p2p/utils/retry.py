@@ -1,0 +1,64 @@
+"""Bounded retry with exponential backoff for transient IO failures.
+
+The durable checkpoint path (:mod:`tpu_p2p.utils.checkpoint`) writes
+every generation file through this helper: real storage — NFS mounts,
+object-store FUSE layers, a busy local disk — fails *transiently* far
+more often than it fails permanently (MegaScale, Jiang et al. 2024
+reports storage-side blips dominating large-run downtime), and a save
+that dies on the first EIO turns a recoverable hiccup into a lost
+generation. The policy here is deliberately minimal and deterministic:
+a fixed attempt budget, exponential backoff with no jitter (the test
+suite and the ``make ckpt-chaos`` smoke must be able to predict the
+exact attempt count for an injected first-N-failures fault), and a
+narrow default exception filter — ``OSError`` only. A
+:class:`~tpu_p2p.obs.faults.SimulatedCrash` derives from
+``BaseException`` precisely so no retry filter can swallow a simulated
+process death.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple, Type
+
+__all__ = ["retry_io", "DEFAULT_ATTEMPTS"]
+
+# Attempt budget shared by every checkpoint write: the injected
+# transient-IO fault (FaultPlan.ckpt_io_errors) must fail fewer
+# attempts than this for the ckpt-chaos transient_io scenario to
+# succeed with zero fallbacks — the smoke grades exactly that margin.
+DEFAULT_ATTEMPTS = 5
+
+
+def retry_io(fn: Callable, *, attempts: int = DEFAULT_ATTEMPTS,
+             base_delay_s: float = 0.002, backoff: float = 2.0,
+             retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+             sleep: Callable[[float], None] = time.sleep,
+             on_retry: Optional[Callable[[int, BaseException], None]]
+             = None):
+    """Call ``fn()`` up to ``attempts`` times, sleeping
+    ``base_delay_s * backoff**k`` after the k-th failure.
+
+    Only exceptions matching ``retry_on`` are retried; anything else
+    (including a ``BaseException`` like
+    :class:`~tpu_p2p.obs.faults.SimulatedCrash`) propagates
+    immediately — a simulated process death must never look like a
+    retryable blip. The final failure re-raises the last exception
+    unchanged. ``on_retry(attempt_index, exc)`` is called before each
+    backoff sleep (1-based index of the attempt that just failed) so
+    callers can count retries into their telemetry.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    delay = float(base_delay_s)
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt == attempts:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            if delay > 0:
+                sleep(delay)
+            delay *= backoff
