@@ -17,7 +17,7 @@ SHELL    := /bin/bash
 
 NATIVE_SO := native/libtpu_p2p_native.so
 
-.PHONY: all native run test tier1 bench obs health serve serve-chaos ckpt-chaos clean
+.PHONY: all native run test tier1 bench obs health serve serve-disagg serve-chaos ckpt-chaos clean
 
 all: native
 
@@ -68,6 +68,16 @@ health:
 # runs anywhere; override with ARGS= on real hardware.
 serve:
 	$(PYTHON) -m tpu_p2p serve $(if $(ARGS),$(ARGS),--cpu-mesh 8)
+
+# Disaggregated prefill/decode serving smoke (docs/serving_disagg.md):
+# tp-heavy prefill submesh + dp decode replicas with ledger-priced
+# KV-page migration between them, then the colocated continuous twin
+# on the same trace — nonzero exit unless every completed request's
+# token stream is BITWISE the colocated engine's. Defaults to the
+# simulated 8-device CPU mesh (prefill 1×tp4 / 4 decode replicas);
+# override with ARGS= on real hardware.
+serve-disagg:
+	$(PYTHON) -m tpu_p2p serve --disagg $(if $(ARGS),$(ARGS),--cpu-mesh 8)
 
 # Serving-resilience chaos smoke (docs/serving_resilience.md): three
 # injected fault scenarios — page-pool clamp → preemption with zero

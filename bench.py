@@ -81,17 +81,17 @@ HEADLINE_KEYS = (
     "pp_step_ms_sched_zb",
     "obs_step_ms_p50",
     "health_detect_steps",
-    "heal_resume_loss_delta",
     "p2p_lat_us_pallas",
     "ring_gbps_xla",
     "ring_gbps_pallas",
     "serve_tokens_per_s",
-    "serve_ttft_ms_p50",
     "serve_tok_ms_p99",
     "serve_preempt_recover_steps",
     "serve_shed_frac_overload",
     "ckpt_recover_steps",
     "ckpt_save_ms_p50",
+    "serve_disagg_tokens_per_s",
+    "serve_kv_migrate_gbps",
     # min_gbps/max_gbps retired from the compact line in round 10 (the
     # pp_* keys took their bytes): they were the designed drop-first
     # tail — never graded, never gated (obs/regress.py TOLERANCES),
@@ -157,6 +157,20 @@ HEADLINE_KEYS = (
     # Both still measure into BENCH_detail.json; their tolerances
     # retired per the tolerance-⊆-headline rule.
     # test_round17_budget_trade pins the move.
+    # Round 18 applied the same rule to two more to make room for the
+    # disaggregated-serving pair serve_disagg_tokens_per_s /
+    # serve_kv_migrate_gbps: serve_ttft_ms_p50 (each engine run's
+    # mixed-step compile lands in the FIRST step — inside TTFT —
+    # with multi-second jitter, which is exactly why the round-15
+    # chaos grader refuses to grade on TTFT; serve_tok_ms_p99 stays
+    # as the graded steady-state host-loop latency tail) and
+    # heal_resume_loss_delta (its own tolerance note says the
+    # abs_floor=0.05 did the real gating, and `make health` gates
+    # the relative parity HARDER at <=5%; health_detect_steps stays
+    # as the graded health key). Both still measure into
+    # BENCH_detail.json; their tolerances retired per the
+    # tolerance-⊆-headline rule. test_round18_budget_trade pins the
+    # move.
 )
 
 
@@ -1505,13 +1519,21 @@ SERVE_VOCAB = 2048
 SERVE_DTYPE = "bfloat16"
 
 
-def _serve_model_cfg():
+def _serve_model_cfg(prefill_tp: int = 1, slots: int = None,
+                     dtype: str = None):
+    """The graded serving model. ``prefill_tp`` (the round-18 disagg
+    metric's prefill submesh size) widens the GQA head counts just
+    enough that KV heads divide the tp axis; ``prefill_tp <= 2``
+    keeps the round-13 model byte-identical."""
     from tpu_p2p.models import flagship as F
 
+    kv = 2 if prefill_tp <= 2 else int(prefill_tp)
     return F.FlagshipConfig(
-        batch=SERVE_SLOTS, seq=64, heads=8, kv_heads=2, head_dim=64,
+        batch=slots if slots is not None else SERVE_SLOTS, seq=64,
+        heads=max(8, 2 * kv), kv_heads=kv, head_dim=64,
         stages=2, microbatches=1, dense_ffn=True, moe_mult=2,
-        vocab=SERVE_VOCAB, norm=True, rope=True, dtype=SERVE_DTYPE,
+        vocab=SERVE_VOCAB, norm=True, rope=True,
+        dtype=dtype if dtype is not None else SERVE_DTYPE,
     )
 
 
@@ -1677,6 +1699,167 @@ def _serve_resilience_metrics(timing):
             + json.dumps({s: res[s].get("ok")
                           for s in ("preempt_clamp", "storm_shed",
                                     "slow_step") if s in res}))
+    return out
+
+
+# Null shape of _serve_disagg_metrics — failure must produce the same
+# keys (schema stability, mirroring the other NULL schemas),
+# serve_disagg_error naming WHY (1-chip runs name the missing second
+# submesh; a parity failure names the broken request set; an honest
+# throughput loss publishes BOTH numbers plus the reason — never a
+# silent null).
+DISAGG_NULL = {
+    "serve_disagg_devices": None,
+    "serve_disagg_tokens_per_s": None,
+    "serve_colocated_tokens_per_s": None,
+    "serve_kv_migrate_gbps": None,
+    "serve_kv_migrated": None,
+    "serve_migrate_wait_steps_max": None,
+    "serve_disagg_parity_ok": None,
+    "serve_disagg_error": None,
+}
+
+# The disagg metric's prefill-side slot batch (module constant so the
+# CPU test suite can shrink it, the SERVE_* precedent).
+DISAGG_PREFILL_SLOTS = 8
+# The disagg metric's model/cache dtype. float32, NOT SERVE_DTYPE's
+# bfloat16, and deliberately so: the graded claim is EXACT token
+# parity vs the colocated engine, and under bf16 the tp-sharded
+# out-projection/FFN joins reassociate the reduction enough to flip
+# near-tie argmaxes (measured: 6/48 streams at prefill_tp=4 on the
+# CPU mesh) — a dtype property of the join, not a scheduler property.
+# bf16 serving throughput is already graded by _serve_metrics; the
+# disagg A/B compares its two engines under ONE dtype either way, so
+# the comparison stays apples to apples.
+DISAGG_DTYPE = "float32"
+
+
+def _serve_disagg_metrics(timing):
+    """Disaggregated prefill/decode serving grades (round 18
+    tentpole — tpu_p2p/serve/disagg.py, docs/serving_disagg.md).
+
+    ``serve_disagg_tokens_per_s``: the graded 48-request staggered
+    trace (the SERVE_* shape) served end to end on the partitioned
+    mesh — a tp-heavy 1×(n/2) prefill submesh feeding n/2 decode
+    replicas through ledger-priced KV-page migration — as wall
+    tokens/s off the real host loop, next to the colocated continuous
+    twin on the same trace (``serve_colocated_tokens_per_s``,
+    detail-only). Disagg must beat colocated on the staggered
+    long-prompt trace; when it loses instead the HONEST pair still
+    publishes and ``serve_disagg_error`` names the reason (on a
+    single-host CPU mesh the two submeshes serialize on one machine,
+    so the win needs hardware that runs them concurrently). A
+    token-stream parity failure vs the colocated twin nulls the
+    graded keys — throughput from wrong tokens is not a number.
+
+    ``serve_kv_migrate_gbps``: shipped migration bits over migration
+    wall — the per-link p2p traffic the ``kind="kv_migrate"`` ledger
+    rows price, the serving-side consumer of the paper's N×N matrix.
+
+    Needs >= 2 devices (a prefill submesh AND a decode submesh);
+    1-chip rounds publish the DISAGG_NULL schema with the reason,
+    like the health smoke does.
+    """
+    import dataclasses
+    import math
+
+    import jax
+
+    from tpu_p2p.config import ServeConfig
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.serve.disagg import (
+        build_disagg_meshes,
+        run_disagg_engine,
+    )
+    from tpu_p2p.serve.engine import (
+        run_engine,
+        serve_mesh,
+        synthetic_trace,
+    )
+
+    out = dict(DISAGG_NULL)
+    n = len(jax.devices())
+    out["serve_disagg_devices"] = n
+    if n < 2:
+        out["serve_disagg_error"] = (
+            f"disagg needs >= 2 devices (a prefill submesh AND a "
+            f"decode submesh); have {n}"
+        )
+        return out
+    pre, dec, mig = build_disagg_meshes()
+    prefill_tp = int(pre.shape["tp"])
+    n_dec = int(dec.shape["dp"])
+    # Slots must divide the decode replica count AND (for the
+    # colocated twin) the full mesh's shard count.
+    m = n_dec * n // math.gcd(n_dec, n)
+    slots = max(m, SERVE_SLOTS // m * m)
+    blocks_worst = -(-(SERVE_PROMPT[1] + SERVE_GEN[1])
+                     // SERVE_PAGE_LEN)
+    pages = slots * blocks_worst + n_dec
+    pages += (-pages) % n_dec
+    sc = ServeConfig(
+        slots=slots, page_len=SERVE_PAGE_LEN, num_pages=pages,
+        max_blocks=SERVE_MAX_BLOCKS, chunk=SERVE_CHUNK,
+        requests=SERVE_REQUESTS, seed=0, rate=SERVE_RATE,
+        prompt_len=SERVE_PROMPT, gen_len=SERVE_GEN, vocab=SERVE_VOCAB,
+        dtype=DISAGG_DTYPE, disagg=True, prefill_tp=prefill_tp,
+        prefill_slots=DISAGG_PREFILL_SLOTS,
+        prefill_pages=((DISAGG_PREFILL_SLOTS + slots)
+                       * SERVE_MAX_BLOCKS + 1),
+    )
+    cfg = _serve_model_cfg(prefill_tp=prefill_tp, slots=slots,
+                           dtype=DISAGG_DTYPE)
+    seeded = F.init_flagship_params(cfg)
+    trace = synthetic_trace(sc)
+    s = run_disagg_engine(
+        pre, dec, mig, cfg,
+        F.place_flagship_params(seeded, pre),
+        F.place_flagship_params(seeded, dec),
+        trace, sc=sc)
+    mesh = serve_mesh(n)
+    co_pages = slots * blocks_worst + n
+    co_pages += (-co_pages) % n
+    sc_co = dataclasses.replace(sc, disagg=False,
+                                num_pages=co_pages, prefill_pages=0)
+    co = run_engine(mesh, cfg, F.place_flagship_params(seeded, mesh),
+                    trace, sc=sc_co, mode="continuous")
+    want = {r.rid: list(r.generated) for r in co["finished"]}
+    got = {r.rid: list(r.generated) for r in s["finished"]}
+    mismatched = sorted(rid for rid in got
+                        if want.get(rid) != got[rid])
+    out["serve_kv_migrated"] = s["kv_migrated"]
+    out["serve_migrate_wait_steps_max"] = s["migrate_wait_steps_max"]
+    if mismatched or len(got) != len(want) or not got:
+        out["serve_disagg_parity_ok"] = False
+        # Name the broken request set whichever way it broke: wrong
+        # streams, requests the disagg side never completed, or
+        # completions the colocated side lacks.
+        missing = sorted(set(want) - set(got))
+        extra = sorted(set(got) - set(want))
+        out["serve_disagg_error"] = (
+            f"token-stream parity vs colocated FAILED: "
+            f"{len(mismatched)}/{len(got)} requests mismatched "
+            f"(first: {mismatched[:4]}), {len(missing)} missing on "
+            f"the disagg side (first: {missing[:4]}), {len(extra)} "
+            f"extra (first: {extra[:4]})"
+        )
+        return out
+    out["serve_disagg_parity_ok"] = True
+    out["serve_disagg_tokens_per_s"] = s["serve_tokens_per_s"]
+    out["serve_colocated_tokens_per_s"] = co["serve_tokens_per_s"]
+    out["serve_kv_migrate_gbps"] = s["serve_kv_migrate_gbps"]
+    if s["serve_tokens_per_s"] <= co["serve_tokens_per_s"]:
+        # The honest loss, published with the reason (the acceptance
+        # contract): both numbers stay, the gate still sees them.
+        ratio = (s["serve_tokens_per_s"]
+                 / max(co["serve_tokens_per_s"], 1e-9))
+        out["serve_disagg_error"] = (
+            f"disagg {ratio:.2f}x colocated on this host: a "
+            "single-process mesh serializes the prefill and decode "
+            "submeshes (plus per-request migration dispatch), so "
+            "the disagg win needs hardware running the submeshes "
+            "concurrently"
+        )
     return out
 
 
@@ -2623,6 +2806,18 @@ def main() -> int:
               file=sys.stderr)
         resil_m = {"serve_resil_error": f"{type(e).__name__}: {e}"}
     result["detail"].update({k: resil_m.get(k) for k in RESIL_NULL})
+    # Disaggregated prefill/decode serving (round-18 tentpole): the
+    # graded staggered trace on the partitioned mesh + the KV-page
+    # migration bandwidth, DISAGG_NULL schema (with the reason) on
+    # 1-chip runs, parity failure, or error.
+    try:
+        disagg_m = _serve_disagg_metrics(timing)
+    except Exception as e:  # noqa: BLE001 — same rationale
+        print(f"# serve disagg measurement failed: {e!r}",
+              file=sys.stderr)
+        disagg_m = {"serve_disagg_error": f"{type(e).__name__}: {e}"}
+    result["detail"].update({k: disagg_m.get(k)
+                             for k in DISAGG_NULL})
     # Checkpoint durability chaos (round-17 tentpole): crash/corrupt/
     # transient-IO recovery off the injected storage faults,
     # CKPT_NULL schema (with the reason) on failure. Runs on any

@@ -111,6 +111,18 @@ SUMMARY_PATTERNS = {
     # pinned; every wall-derived rate/latency magnitude masks.
     "serve": ["serve", "--cpu-mesh", "8", "--requests", "6",
               "--seed", "0", "--batching", "both"],
+    # The round-18 disaggregated serving end to end on the 8-device
+    # mesh: prefill 1×tp4 / decode 4 replicas, chunked prefill on the
+    # tp submesh, per-request KV-page migration over instrumented
+    # p2p ships, then the colocated continuous twin on the same
+    # trace. Request/step/migration/page counts are
+    # schedule-deterministic and stay pinned; every wall-derived
+    # rate/latency/MiB magnitude masks. The "token parity OK (6/6
+    # bitwise)" line IS the acceptance criterion riding the golden —
+    # _run_cli asserts rc 0, and _disagg_cli returns nonzero on any
+    # token-stream mismatch vs the colocated engine.
+    "serve_disagg": ["serve", "--cpu-mesh", "8", "--disagg",
+                     "--requests", "6", "--seed", "0"],
     # The round-15 chaos smoke end to end on the 8-device mesh: three
     # injected fault scenarios (page-pool clamp → preemption, request
     # storm → shedding, slow host → schedule invariance) graded like

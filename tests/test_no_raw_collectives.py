@@ -108,11 +108,27 @@ def test_lint_scans_the_expected_trees():
     # The round-13 serve tree is covered (paged_cache.py issues the
     # decode psum joins through the wrappers; a regression that drops
     # serve/ from SCANNED must fail here, not ship silently). Round
-    # 15's resilience.py rides the same coverage.
+    # 15's resilience.py rides the same coverage. Round 18's
+    # disagg.py is the one whose ships ARE transport: the KV-page
+    # migration hops (kind="kv_migrate") are the whole point of the
+    # module, and a raw ppermute there would leak the migration
+    # traffic past the ledger exactly like the round-9 moe.py hole —
+    # so the scanned set must keep covering it AND the module must
+    # actually contain the instrumented lowering call.
     assert "paged_cache.py" in names and "batcher.py" in names, \
         sorted(names)
     assert "resilience.py" in names, sorted(names)
-    assert len(files) >= 18, files
+    assert "disagg.py" in names, sorted(names)
+    disagg_src = next(p for p in files
+                      if os.path.basename(p) == "disagg.py")
+    with open(disagg_src) as fh:
+        disagg_text = fh.read()
+    assert "chunked_ppermute_compute" in disagg_text \
+        and "kv_migrate" in disagg_text, (
+            "the migration ship moved out of serve/disagg.py — "
+            "extend SCANNED (and this self-test) to wherever it went"
+        )
+    assert len(files) >= 19, files
 
 
 # ---------------------------------------------------- pallas transport

@@ -1,0 +1,485 @@
+"""Disaggregated prefill/decode serving (round 18 tentpole —
+tpu_p2p/serve/disagg.py, docs/serving_disagg.md).
+
+The load-bearing pin is BITWISE token-stream parity vs the colocated
+engine on every tier-1 mesh shape (tp-heavy prefill + replica decode,
+including the MoE path under no-drop capacity) — the shared
+``decode._attend_ffn`` body is the parity anchor, and migration moves
+bytes verbatim. Plus: the device-free schedule twin is event-exact
+(dry == real including migration events), decode-side preemption
+re-enqueues to the PREFILL side with zero completed-token loss, the
+migration queue drains FIFO with waits surfaced, the ``kv_migrate``
+ledger rows price per-link like ppermute with prefill→decode edges,
+two coexisting pools stay debuggable (identity in messages and
+records), and ``obs watch`` alerts on migration stalls.
+"""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_p2p.config import ServeConfig
+from tpu_p2p.models import flagship as F
+from tpu_p2p.obs import ledger as L
+from tpu_p2p.serve.batcher import Request
+from tpu_p2p.serve.disagg import (
+    DisaggBatcher,
+    build_disagg_meshes,
+    run_disagg_engine,
+    simulate_disagg_schedule,
+)
+from tpu_p2p.serve.engine import (
+    _engine_model,
+    run_engine,
+    serve_mesh,
+    synthetic_trace,
+)
+from tpu_p2p.serve.paged_cache import OutOfPages, PagePool
+
+
+def _cfg(prefill_tp=1, **kw):
+    # capacity_factor = num_experts → no token ever drops (the
+    # test_serve convention); kv heads sized to divide the prefill tp.
+    kv = max(2, prefill_tp)
+    base = dict(batch=4, seq=16, heads=2 * kv, kv_heads=kv,
+                head_dim=8, stages=2, microbatches=1, num_experts=2,
+                capacity_factor=2.0, vocab=64, norm=True, rope=True)
+    base.update(kw)
+    return F.FlagshipConfig(**base)
+
+
+def _sc(n_dec, **kw):
+    base = dict(slots=2 * n_dec, page_len=8, num_pages=0,
+                max_blocks=3, chunk=4, requests=5, seed=0, rate=1.0,
+                prompt_len=(4, 12), gen_len=(4, 8), vocab=64,
+                disagg=True, prefill_slots=2)
+    base.update(kw)
+    if not base["num_pages"]:
+        base["num_pages"] = n_dec * (base["slots"] // n_dec
+                                     * base["max_blocks"] + 1)
+    if not base.get("prefill_pages"):
+        base["prefill_pages"] = (base["prefill_slots"]
+                                 + base["slots"]) * base["max_blocks"] + 1
+    return ServeConfig(**base)
+
+
+def _run_disagg(sc, cfg, seeded, prefill_tp, n_devices, trace,
+                **engine_kw):
+    pre, dec, mig = build_disagg_meshes(
+        prefill_tp, devices=jax.devices()[:n_devices])
+    return run_disagg_engine(
+        pre, dec, mig, cfg,
+        F.place_flagship_params(seeded, pre),
+        F.place_flagship_params(seeded, dec),
+        trace, sc=sc, **engine_kw)
+
+
+def _colocated_streams(cfg, seeded, trace, sc):
+    mesh = serve_mesh(1)
+    sc_co = dataclasses.replace(
+        sc, disagg=False, slots=4,
+        num_pages=4 * sc.max_blocks + 1, prefill_pages=0)
+    co = run_engine(mesh, cfg, F.place_flagship_params(seeded, mesh),
+                    trace, sc=sc_co, mode="continuous")
+    return {r.rid: list(r.generated) for r in co["finished"]}
+
+
+# ------------------------------------------------------ mesh builder
+
+
+def test_build_disagg_meshes_shapes_and_validation():
+    pre, dec, mig = build_disagg_meshes(4)
+    assert dict(pre.shape) == {"dp": 1, "tp": 4}
+    assert dict(dec.shape) == {"dp": 4}
+    assert dict(mig.shape) == {"mig": 8}
+    # mig rank order: prefill devices first — the ledger's edge ids.
+    assert list(mig.devices.flat)[:4] == list(pre.devices.flat)
+    # Auto split: half the devices.
+    pre, dec, _ = build_disagg_meshes()
+    assert dict(pre.shape) == {"dp": 1, "tp": 4}
+    with pytest.raises(ValueError, match="partition"):
+        build_disagg_meshes(8)  # no decode replica left
+    with pytest.raises(ValueError, match="partition"):
+        build_disagg_meshes(9)
+    with pytest.raises(ValueError, match=">= 2 devices"):
+        build_disagg_meshes(1, devices=jax.devices()[:1])
+
+
+def test_serve_config_disagg_validation():
+    with pytest.raises(ValueError, match="transport"):
+        _sc(2, transport="carrier_pigeon")
+    with pytest.raises(ValueError, match="migrate_chunks"):
+        _sc(2, migrate_chunks=0)
+    with pytest.raises(ValueError, match="prefill_slots"):
+        _sc(2, prefill_slots=0)
+    with pytest.raises(ValueError, match="prefill_tp"):
+        _sc(2, prefill_tp=-1)
+
+
+# ------------------------------------------------- token parity pins
+
+
+@pytest.mark.parametrize("prefill_tp,n_devices,cfg_kw", [
+    (1, 2, dict(dense_ffn=True)),           # smallest split
+    (2, 4, dict(dense_ffn=True)),           # tp-heavy prefill
+    (2, 4, dict()),                          # MoE path, no-drop
+], ids=["tp1+1", "tp2+2", "tp2+2-moe"])
+def test_disagg_tokens_bitwise_vs_colocated(prefill_tp, n_devices,
+                                            cfg_kw):
+    n_dec = n_devices - prefill_tp
+    sc = _sc(n_dec, prefill_tp=prefill_tp)
+    cfg = _cfg(prefill_tp, **cfg_kw)
+    seeded = F.init_flagship_params(cfg)
+    trace = synthetic_trace(sc)
+    s = _run_disagg(sc, cfg, seeded, prefill_tp, n_devices, trace)
+    assert s["requests"] == len(trace)
+    assert s["kv_migrated"] > 0
+    want = _colocated_streams(cfg, seeded, trace, sc)
+    got = {r.rid: list(r.generated) for r in s["finished"]}
+    assert got == want  # BITWISE token streams, every request
+
+
+@pytest.mark.slow  # tier-1 budget: the 8-dev golden shape (tp4 + 4
+# replicas) runs a wider-GQA model end to end
+def test_disagg_tokens_bitwise_golden_shape_tp4():
+    sc = _sc(4, prefill_tp=4, requests=6)
+    cfg = _engine_model(sc, prefill_tp=4)
+    seeded = F.init_flagship_params(cfg)
+    trace = synthetic_trace(sc)
+    s = _run_disagg(sc, cfg, seeded, 4, 8, trace)
+    want = _colocated_streams(cfg, seeded, trace, sc)
+    got = {r.rid: list(r.generated) for r in s["finished"]}
+    assert got == want
+
+
+def test_disagg_migration_over_pallas_dma_transport():
+    from tpu_p2p.parallel.runtime import pallas_dma_supported
+
+    if not pallas_dma_supported():
+        pytest.skip("pallas_dma capability probe failed here")
+    # The migration ship honors the transport knob: raw async remote
+    # copies (interpret mode on CPU) move the same bytes, tokens stay
+    # bitwise, and the ledger rows keep the kv_migrate kind with the
+    # transport in the label.
+    sc = _sc(1, prefill_tp=1, requests=3, transport="pallas_dma")
+    cfg = _cfg(1, dense_ffn=True)
+    seeded = F.init_flagship_params(cfg)
+    trace = synthetic_trace(sc)
+    led = L.CollectiveLedger()
+    s = _run_disagg(sc, cfg, seeded, 1, 2, trace, ledger=led)
+    want = _colocated_streams(cfg, seeded, trace, sc)
+    got = {r.rid: list(r.generated) for r in s["finished"]}
+    assert got == want
+    rows = [it for it in led.issues if it.kind == "kv_migrate"]
+    assert rows and all("pallas_dma" in it.label for it in rows)
+
+
+# ------------------------------------------- preemption + shedding
+
+
+def _tight_decode_sc(n_dec=2, **kw):
+    # Decode pool of 3 usable pages/shard with 3-block worst requests
+    # → two concurrent worst-case occupants of a shard MUST preempt,
+    # while any sole occupant still finishes (the admission guard).
+    base = dict(slots=2 * n_dec, num_pages=4 * n_dec, requests=8,
+                rate=3.0, gen_len=(6, 8), prefill_slots=3)
+    base.update(kw)
+    return _sc(n_dec, **base)
+
+
+def test_decode_preemption_reenqueues_to_prefill_zero_loss():
+    sc = _tight_decode_sc()
+    cfg = _cfg(2, dense_ffn=True)
+    seeded = F.init_flagship_params(cfg)
+    trace = synthetic_trace(sc)
+    s = _run_disagg(sc, cfg, seeded, 2, 4, trace)
+    assert s["preemptions"] > 0
+    # Zero completed-token loss: every request full-length.
+    assert all(len(r.generated) == r.max_new for r in s["finished"])
+    assert len(s["finished"]) == len(trace)
+    # Preempted victims re-entered the PREFILL side: they migrated
+    # again (recompute prefill → second migration) and their events
+    # say so.
+    pre_rids = {r.rid for r in s["finished"] if r.preemptions}
+    assert pre_rids
+    for r in s["finished"]:
+        if r.preemptions:
+            assert r.migrations >= 2
+    assert all(e["side"] == "decode" for e in
+               simulate_disagg_schedule(
+                   trace, slots=sc.slots,
+                   prefill_slots=sc.prefill_slots,
+                   page_len=sc.page_len, num_pages=sc.num_pages,
+                   prefill_pages=sc.prefill_pages,
+                   max_blocks=sc.max_blocks, chunk=sc.chunk,
+                   n_decode_shards=2, cfg=cfg)["preempt_events"])
+    # And parity still holds — recompute replays the same chunk
+    # schedule, so even preempted streams match colocated bitwise.
+    want = _colocated_streams(cfg, seeded, trace, sc)
+    got = {r.rid: list(r.generated) for r in s["finished"]}
+    assert got == want
+
+
+def test_migration_queue_fifo_order_and_waits():
+    # One decode replica with one slot: completed prefills queue up
+    # and MUST migrate in completion (FIFO) order, with waits > 0
+    # surfaced once the decode slot is held.
+    sc = _sc(1, slots=1, prefill_slots=3, requests=4, rate=4.0,
+             num_pages=4)
+    dry = simulate_disagg_schedule(
+        trace=synthetic_trace(sc), slots=1, prefill_slots=3,
+        page_len=sc.page_len, num_pages=sc.num_pages,
+        prefill_pages=sc.prefill_pages, max_blocks=sc.max_blocks,
+        chunk=sc.chunk, n_decode_shards=1)
+    evs = dry["migrate_events"]
+    assert len(evs) == 4
+    # FIFO: migration order == prefill completion order; the dry
+    # requests carry prefill_done_step.
+    done = {r.rid: r.prefill_done_step for r in dry["requests"]}
+    order = [e["rid"] for e in evs]
+    assert order == sorted(order, key=lambda rid: (done[rid], rid))
+    # The single decode slot serializes: later migrations waited.
+    assert max(e["wait_steps"] for e in evs) > 0
+    waits = {r.rid: r.migrate_wait_steps for r in dry["requests"]}
+    for e in evs:
+        assert waits[e["rid"]] >= e["wait_steps"]
+
+
+def test_deadline_sheds_only_queued_requests():
+    # Tight deadline: queued requests shed, but anything in flight —
+    # prefilling, awaiting migration, or decoding — is exempt (the
+    # zero-loss contract).
+    sc = _sc(1, slots=1, prefill_slots=1, requests=6, rate=6.0,
+             deadline_steps=4, num_pages=4)
+    dry = simulate_disagg_schedule(
+        trace=synthetic_trace(sc), slots=1, prefill_slots=1,
+        page_len=sc.page_len, num_pages=sc.num_pages,
+        prefill_pages=sc.prefill_pages, max_blocks=sc.max_blocks,
+        chunk=sc.chunk, n_decode_shards=1,
+        deadline_steps=sc.deadline_steps)
+    assert dry["shed"]
+    for r in dry["shed"]:
+        assert r.outcome == "shed_deadline"
+        assert r.prefill_start_step is None  # never started service
+    for r in dry["requests"]:
+        assert len(r.generated) == r.max_new  # completed = full
+
+
+# ----------------------------------------------------- dry == real
+
+
+def test_dry_schedule_twin_is_event_exact():
+    sc = _sc(2, requests=6, rate=1.5, seed=3)
+    cfg = _cfg(2, dense_ffn=True)
+    seeded = F.init_flagship_params(cfg)
+    trace = synthetic_trace(sc)
+    s = _run_disagg(sc, cfg, seeded, 2, 4, trace)
+    dry = simulate_disagg_schedule(
+        trace, slots=sc.slots, prefill_slots=sc.prefill_slots,
+        page_len=sc.page_len, num_pages=sc.num_pages,
+        prefill_pages=sc.prefill_pages, max_blocks=sc.max_blocks,
+        chunk=sc.chunk, n_decode_shards=2, cfg=cfg)
+    assert dry["steps"] == s["steps"]
+    assert len(dry["events"]) == len(s["events"])
+    for er, ed in zip(s["events"], dry["events"]):
+        assert er["step"] == ed["step"]
+        assert er["migrations"] == ed["migrations"]
+        for k in ("p_pos", "p_n", "p_tables", "d_pos", "d_n",
+                  "d_tables"):
+            np.testing.assert_array_equal(er[k], ed[k], err_msg=k)
+    assert dry["migrate_events"] == s["migrate_events"]
+    assert dry["kv_migrate_bytes"] == s["kv_migrate_bytes"]
+
+
+# ------------------------------------------------------- the ledger
+
+
+def test_kv_migrate_prices_per_link_like_ppermute():
+    assert L.wire_bytes("kv_migrate", 8, 4096) == 4096
+    assert L.wire_bytes("kv_migrate", 8, 4096) == \
+        L.wire_bytes("ppermute", 8, 4096)
+    # The device-event vocabulary knows the kind (a named migration
+    # kernel would match), and the transport aliasing files XLA-label
+    # rows into the collective-permute pool, pallas rows into dma's.
+    assert L.kind_of_event("kv_migrate_ship.3") == "kv_migrate"
+    xla = L.CollectiveIssue(kind="kv_migrate", axis="mig",
+                            participants=(0, 1), payload_bytes=16,
+                            wire_bytes=16, label="kv_migrate:xla")
+    dma = dataclasses.replace(xla, label="kv_migrate:pallas_dma")
+    assert L._match_kind(xla) == "ppermute"
+    assert L._match_kind(dma) == "dma"
+    assert L._match_kind(dataclasses.replace(xla, kind="ppermute",
+                                             label="x")) == "ppermute"
+    # kv_migrate sits on the XLA side of the head-to-head matrix
+    # split (it is not the pallas transport).
+    assert "kv_migrate" in L.non_dma_kinds()
+
+
+def test_migration_records_kv_migrate_rows_with_bipartite_edges():
+    sc = _sc(2, requests=4)
+    cfg = _cfg(2, dense_ffn=True)
+    seeded = F.init_flagship_params(cfg)
+    trace = synthetic_trace(sc)
+    led = L.CollectiveLedger()
+    recs = []
+    s = _run_disagg(sc, cfg, seeded, 2, 4, trace, ledger=led,
+                    emit=recs.append)
+    rows = [it for it in led.issues if it.kind == "kv_migrate"]
+    assert rows, "migrations must record kv_migrate ledger rows"
+    n_pre = 2
+    for it in rows:
+        assert it.axis == "mig"
+        assert it.wire_bytes == it.payload_bytes  # per-link pricing
+        assert it.label == "kv_migrate:xla"
+        for src, dst in it.edges:
+            # Bipartite: prefill rank → decode rank, every time.
+            assert src < n_pre <= dst
+    # The ledger's migration byte total is exactly the engine's
+    # accounting for the migrations that TRACED (programs are cached
+    # per (blocks, dst) shape — retraces don't re-record, the scan
+    # convention), so totals are a lower bound hit exactly when every
+    # migration has a distinct shape.
+    led_bytes = sum(it.payload_bytes * it.count for it in rows)
+    assert 0 < led_bytes <= s["kv_migrate_bytes"]
+    # The serve_ledger receipt carries the kind.
+    receipt = [r for r in recs if r.get("obs") == "serve_ledger"][0]
+    assert any(k.startswith("kv_migrate/") for k in receipt["totals"])
+    # And the per-request records carry the migration lifecycle.
+    req_recs = [r for r in recs if r.get("obs") == "request"]
+    assert all(r["pool"] == "decode" for r in req_recs)
+    assert all(r["migrations"] >= 1 for r in req_recs)
+    assert all(r["migrate_step"] is not None for r in req_recs)
+
+
+def test_colocated_records_keep_schema_with_pool_tag():
+    mesh = serve_mesh(1)
+    sc = ServeConfig(slots=4, page_len=8, num_pages=16, max_blocks=3,
+                     chunk=4, requests=3)
+    cfg = _cfg(1, dense_ffn=True)
+    params = F.place_flagship_params(F.init_flagship_params(cfg),
+                                     mesh)
+    recs = []
+    run_engine(mesh, cfg, params, synthetic_trace(sc), sc=sc,
+               mode="continuous", emit=recs.append)
+    req_recs = [r for r in recs if r.get("obs") == "request"]
+    assert req_recs
+    for r in req_recs:
+        assert r["pool"] == "kv"  # the single colocated pool
+        # No migration keys on colocated records (schema additivity:
+        # round-15 consumers see one new key, not six).
+        assert "migrate_step" not in r
+        assert "migrations" not in r
+        json.dumps(r)
+
+
+# ------------------------------------------------- pool identity
+
+
+def test_pool_identity_in_messages_and_defaults():
+    p = PagePool(8, 8, 1, name="prefill")
+    d = PagePool(8, 8, 1, name="decode")
+    assert PagePool(8, 8, 1).name == "kv"  # colocated default
+    for _ in range(p.capacity):
+        p.alloc(0)
+    with pytest.raises(OutOfPages, match="'prefill'"):
+        p.alloc(0)
+    with pytest.raises(OutOfPages, match="'decode'"):
+        d.alloc_n(d.capacity + 1, 0)
+    with pytest.raises(ValueError, match="'decode'"):
+        d.free([1], 0)  # not allocated
+    with pytest.raises(RuntimeError, match="'prefill'"):
+        p.clamp_capacity(1)  # live allocations
+
+
+def test_disagg_batcher_distinguishes_pool_exhaustion():
+    # A request that could never fit the DECODE pool must say so by
+    # name at admission — not fail ambiguously mid-flight.
+    sc = _sc(1, num_pages=3, prompt_len=(4, 4), gen_len=(4, 4),
+             max_blocks=3)
+    b = DisaggBatcher(
+        None, None, None, None, None, None, slots=sc.slots,
+        prefill_slots=sc.prefill_slots, page_len=sc.page_len,
+        num_pages=sc.num_pages, prefill_pages=sc.prefill_pages,
+        max_blocks=sc.max_blocks, chunk=sc.chunk, dry=True,
+        n_decode_shards=1)
+    big = Request(rid=0, prompt=np.zeros(20, np.int32), max_new=4)
+    b.submit(big)
+    with pytest.raises(ValueError, match="decode shard"):
+        b.step()
+
+
+# ---------------------------------------------------- obs watch
+
+
+def _watch(tmp_path, rows, *args):
+    import io
+
+    from tpu_p2p.obs.health import watch_main
+
+    path = tmp_path / "obs.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    out = io.StringIO()
+    rc = watch_main([str(path), *args], stream=out)
+    return rc, out.getvalue()
+
+
+def _mig_row(rid, wait, shard=0):
+    return {"obs": "request", "id": rid, "outcome": "completed",
+            "pool": "decode", "migrations": 1, "migrate_step": 7,
+            "migrate_wait_steps": wait, "decode_shard": shard}
+
+
+def test_watch_alerts_on_migration_stall(tmp_path):
+    rows = [_mig_row(0, 1), _mig_row(1, 9, shard=2)]
+    rc, text = _watch(tmp_path, rows, "--max-migrate-wait-steps", "4")
+    assert rc == 1
+    assert "migrate_stall" in text and "id=1" in text
+    assert "2 migrated request row(s), worst migrate wait 9" in text
+    # Under the bound: summary prints, no alert, exit 0.
+    rc, text = _watch(tmp_path, [_mig_row(0, 1)],
+                      "--max-migrate-wait-steps", "4")
+    assert rc == 0 and "migrate_stall" not in text
+    assert "1 migrated request row(s)" in text
+    # Default: no migration-stall alerting (wait 9 tolerated), but
+    # the summary line still surfaces the worst wait.
+    rc, text = _watch(tmp_path, rows)
+    assert rc == 0 and "worst migrate wait 9" in text
+
+
+def test_watch_colocated_stream_has_no_migration_line(tmp_path):
+    rows = [{"obs": "request", "id": 0, "outcome": "completed",
+             "pool": "kv", "preemptions": 0}]
+    rc, text = _watch(tmp_path, rows)
+    assert rc == 0
+    assert "migrated request row" not in text
+    assert "1 request row(s)" in text
+
+
+# ------------------------------------------------- graded (bench)
+
+
+@pytest.mark.slow  # a real two-engine run of the graded SHAPE (trace
+# shrunk via the module constants, the SERVE_* precedent)
+def test_bench_disagg_metric_publishes_with_parity(monkeypatch):
+    import bench
+
+    from tpu_p2p.utils import timing
+
+    monkeypatch.setattr(bench, "SERVE_REQUESTS", 10)
+    monkeypatch.setattr(bench, "SERVE_SLOTS", 8)
+    monkeypatch.setattr(bench, "DISAGG_PREFILL_SLOTS", 4)
+    out = bench._serve_disagg_metrics(timing)
+    assert out["serve_disagg_parity_ok"] is True, out
+    assert out["serve_disagg_tokens_per_s"] is not None
+    assert out["serve_colocated_tokens_per_s"] is not None
+    assert out["serve_kv_migrate_gbps"] is not None
+    assert out["serve_kv_migrated"] > 0
+    # Either disagg won, or the honest loss published with a reason.
+    if out["serve_disagg_tokens_per_s"] <= \
+            out["serve_colocated_tokens_per_s"]:
+        assert "colocated" in out["serve_disagg_error"]
+    else:
+        assert out["serve_disagg_error"] is None

@@ -365,6 +365,26 @@ class ServeConfig:
     stop: str = "length"      # stop rule, one of SERVE_STOPS
     eos_prob: float = 0.1     # stop="eos": per-token seeded stop
     # probability (geometric lengths capped by max_new)
+    # Disaggregated prefill/decode (round 18,
+    # docs/serving_disagg.md) — all default-off, preserving the
+    # colocated engine byte for byte:
+    disagg: bool = False      # partition the device mesh into a
+    # tp-heavy prefill submesh and a replica-heavy decode submesh;
+    # completed prefills migrate their KV pages across as explicit
+    # instrumented p2p transfers (ledger kind="kv_migrate")
+    prefill_tp: int = 0       # prefill submesh tp size == its device
+    # count (the submesh is 1×tp by construction); 0 = auto, half the
+    # devices. Validated like build_mesh where the devices exist —
+    # serve/disagg.build_disagg_meshes.
+    prefill_slots: int = 4    # prefill-side slot batch (chunked
+    # prefill only; decode slots stay `slots`)
+    prefill_pages: int = 0    # prefill-side page pool (one shard);
+    # 0 = auto, sized by the engine to the worst-case resident set
+    migrate_chunks: int = 1   # KV-migration ship split into this many
+    # chunk hops (chunked_ppermute_compute's wave; 1 = one-shot)
+    transport: str = "xla"    # migration ship transport, one of
+    # TRANSPORTS — the same knob the p2p workloads carry
+    # (xla = CollectivePermute, pallas_dma = raw async remote copies)
 
     def __post_init__(self) -> None:
         if self.page_len <= 0 or self.page_len % 8:
@@ -415,4 +435,22 @@ class ServeConfig:
             raise ValueError(
                 f"worst-case request ({need} tokens) overruns the "
                 f"max_blocks*page_len window ({window})"
+            )
+        if self.transport not in TRANSPORTS:
+            raise ValueError(
+                f"unknown transport {self.transport!r}; expected one "
+                f"of {TRANSPORTS}"
+            )
+        if self.migrate_chunks < 1:
+            raise ValueError(
+                f"migrate_chunks must be >= 1, got {self.migrate_chunks}"
+            )
+        if self.prefill_tp < 0 or self.prefill_pages < 0:
+            raise ValueError(
+                "prefill_tp and prefill_pages must be >= 0 (0 = auto)"
+            )
+        if self.prefill_slots <= 0:
+            raise ValueError(
+                f"prefill_slots must be positive, got "
+                f"{self.prefill_slots}"
             )

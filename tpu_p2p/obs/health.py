@@ -626,7 +626,9 @@ def _build_watch_parser() -> argparse.ArgumentParser:
                     "re-scored from the step rows (median/MAD), so "
                     "un-monitored logs alert too, serve "
                     "{'obs': 'request'} shed verdicts alert past "
-                    "--max-shed-frac, and checkpoint {'obs': 'ckpt'} "
+                    "--max-shed-frac, disagg KV-migration stalls "
+                    "alert past --max-migrate-wait-steps, and "
+                    "checkpoint {'obs': 'ckpt'} "
                     "fallback / crash-restart verdicts always alert "
                     "(storage damage is never routine; "
                     "docs/checkpoint_durability.md). Exit codes "
@@ -658,6 +660,14 @@ def _build_watch_parser() -> argparse.ArgumentParser:
                         "exceeds F (default 0: any shed alerts — a "
                         "healthy trace sheds nothing; "
                         "docs/serving_resilience.md)")
+    p.add_argument("--max-migrate-wait-steps", type=int, default=None,
+                   metavar="N",
+                   help="disaggregated serving: alert on a request "
+                        "whose KV migration waited more than N "
+                        "scheduler steps for decode capacity "
+                        "(migrate_wait_steps on the request record; "
+                        "default: no migration-stall alerting; "
+                        "docs/serving_disagg.md)")
     return p
 
 
@@ -678,10 +688,13 @@ def watch_main(argv: Optional[Sequence[str]] = None,
     shed = 0
     ckpt_rows = 0
     ckpt_bad = 0
+    migrated = 0
+    worst_wait = 0
 
     def handle(line: str) -> bool:
         """→ True when this row alerted."""
         nonlocal alerts, steps, requests, shed, ckpt_rows, ckpt_bad
+        nonlocal migrated, worst_wait
         line = line.strip()
         if not line:
             return False
@@ -707,6 +720,27 @@ def watch_main(argv: Optional[Sequence[str]] = None,
                         detail={"id": rec.get("id"),
                                 "shed_frac": round(shed / requests,
                                                    4)})
+                    out.write(f"# ALERT {v.describe()}\n")
+                    hit = True
+            if rec.get("migrate_step") is not None \
+                    or rec.get("migrations"):
+                # Disagg KV-migration lifecycle (round 18,
+                # docs/serving_disagg.md): a completed prefill that
+                # waited past the bound for decode capacity is a
+                # migration STALL — decode slots/pages are the
+                # bottleneck, not the prefill submesh.
+                migrated += 1
+                wait = int(rec.get("migrate_wait_steps") or 0)
+                worst_wait = max(worst_wait, wait)
+                if (args.max_migrate_wait_steps is not None
+                        and wait > args.max_migrate_wait_steps):
+                    v = HealthVerdict(
+                        kind="migrate_stall",
+                        step=int(rec.get("migrate_step") or 0),
+                        detail={"id": rec.get("id"),
+                                "migrate_wait_steps": wait,
+                                "decode_shard":
+                                    rec.get("decode_shard")})
                     out.write(f"# ALERT {v.describe()}\n")
                     hit = True
         elif rec.get("obs") == "ckpt":
@@ -771,6 +805,12 @@ def watch_main(argv: Optional[Sequence[str]] = None,
         # watches (and their golden) keep the round-12 byte contract.
         out.write(f"# watch: {requests} request row(s), {shed} shed "
                   f"(frac {shed / requests:.4f})\n")
+    if migrated:
+        # Same contract one layer down: the migration summary exists
+        # only when kv_migrate lifecycle rows do (disagg runs), so
+        # colocated serve watches stay byte-identical.
+        out.write(f"# watch: {migrated} migrated request row(s), "
+                  f"worst migrate wait {worst_wait} step(s)\n")
     if ckpt_rows:
         # Same contract: the line exists only when ckpt records do.
         out.write(f"# watch: {ckpt_rows} ckpt row(s), {ckpt_bad} "

@@ -83,6 +83,7 @@ __all__ = [
 # ``dma_transport`` prefix there — first in the table so a Pallas hop
 # can never mis-file under an XLA collective kind.
 KINDS = (
+    ("kv_migrate", "kv_migrate"),
     ("dma", "dma_transport"),
     ("ppermute", "collective-permute"),
     ("all_gather", "all-gather"),
@@ -91,6 +92,27 @@ KINDS = (
     ("all_reduce", "all-reduce"),
 )
 _KIND_NAMES = tuple(k for k, _ in KINDS)
+
+# "kv_migrate" is a WORKLOAD kind, not a transport: the serving
+# KV-page migration ship (tpu_p2p/serve/disagg.py,
+# docs/serving_disagg.md) records its hops under it so the obs report
+# and the MULTICHIP matrix see migration traffic as its own row, but
+# the bytes move over one of the permute transports — an XLA
+# CollectivePermute or a Pallas raw-DMA kernel — whose device events
+# carry THAT transport's name. join_trace therefore matches
+# kv_migrate entries against the transport's event pool (the label
+# names it: "kv_migrate:xla" / "kv_migrate:pallas_dma") while
+# aggregation and the link matrix keep the kv_migrate identity.
+_KV_MIGRATE = "kv_migrate"
+
+
+def _match_kind(issue: "CollectiveIssue") -> str:
+    """The device-event pool a ledger entry's events land in —
+    identity for every transport kind, the label-named transport for
+    the kv_migrate workload kind (see the note above)."""
+    if issue.kind == _KV_MIGRATE:
+        return "dma" if "pallas" in issue.label else "ppermute"
+    return issue.kind
 
 
 def non_dma_kinds():
@@ -121,11 +143,12 @@ def wire_bytes(kind: str, axis_size: int, payload_bytes: int) -> int:
     module docstring for the per-kind algebra.
     """
     n = int(axis_size)
-    if kind in ("ppermute", "dma"):
+    if kind in ("ppermute", "dma", "kv_migrate"):
         # Per directed link — a raw-DMA hop ships the same bytes over
         # the same edge as its CollectivePermute twin, so the two
         # transports price identically and the head-to-head matrix is
-        # apples to apples.
+        # apples to apples. kv_migrate is a ppermute-family ship
+        # (the serving KV-page migration) and prices the same way.
         return int(payload_bytes)
     if kind == "all_gather":
         return (n - 1) * int(payload_bytes)
@@ -385,7 +408,11 @@ def join_trace(ledger: CollectiveLedger, trace_dir: str,
         by_kind_events.setdefault(kind, []).append((name, t0, t1))
     by_kind_issues: Dict[str, List[CollectiveIssue]] = {}
     for it in ledger.expanded():
-        by_kind_issues.setdefault(it.kind, []).append(it)
+        # kv_migrate entries match the transport's event pool (their
+        # device events ARE collective-permute / dma_transport ops)
+        # while keeping their own kind for aggregation — see the
+        # _match_kind note by KINDS.
+        by_kind_issues.setdefault(_match_kind(it), []).append(it)
     joined: List[JoinedEvent] = []
     ragged: List[str] = []
     for kind, evs in by_kind_events.items():

@@ -136,15 +136,26 @@ TOLERANCES: Dict[str, Tolerance] = {
     # real gating (any delta <= 0.05 passes; the smoke's own relative
     # gate is stricter), because one lucky near-cancellation round
     # would otherwise min-ratchet an unpassable reference.
+    # heal_resume_loss_delta retired round 18 with its compact-line
+    # slot (its own note below conceded the abs_floor did the real
+    # gating, and `make health` gates the relative parity harder at
+    # <=5%; health_detect_steps stays as the graded health key) —
+    # the disagg serving pair took the bytes (bench.py HEADLINE_KEYS
+    # note; test_round18_budget_trade).
     "health_detect_steps": Tolerance("lower", 1.00),
-    "heal_resume_loss_delta": Tolerance("lower", 1.00, abs_floor=0.05),
     # PR 8 serving-engine keys (bench.py _serve_metrics). The
     # tokens/s number rides the device-trace replay slope (25%, like
     # the achieved-Gbps family); the request-latency tails ride the
     # real host loop — the jitteriest family (50%, like the 8 B
     # latency floors).
+    # serve_ttft_ms_p50 retired round 18 with its compact-line slot
+    # (each engine run's mixed-step compile lands in the first step —
+    # inside TTFT — with multi-second jitter, the same reason the
+    # round-15 chaos grader refuses to grade on TTFT; the
+    # steady-state tok p99 stays as the graded host-loop tail) — the
+    # disagg serving pair took the bytes (bench.py HEADLINE_KEYS
+    # note; test_round18_budget_trade).
     "serve_tokens_per_s": Tolerance("higher", 0.25),
-    "serve_ttft_ms_p50": Tolerance("lower", 0.50),
     "serve_tok_ms_p99": Tolerance("lower", 0.50),
     # PR 10 serving-resilience keys (bench.py
     # _serve_resilience_metrics): both are SCHEDULE-deterministic
@@ -172,6 +183,15 @@ TOLERANCES: Dict[str, Tolerance] = {
     # not min-ratchet an unpassable bar.
     "ckpt_recover_steps": Tolerance("lower", 1.00),
     "ckpt_save_ms_p50": Tolerance("lower", 0.50, abs_floor=50.0),
+    # PR 13 disaggregated-serving keys (bench.py
+    # _serve_disagg_metrics, docs/serving_disagg.md). Both ride the
+    # real host loop — the jitteriest family, and the disagg
+    # tokens/s additionally publishes only on >= 2-device rounds (a
+    # 1-chip round SKIPs, the pallas-pair precedent) — so both get
+    # the loose wall-clock tolerance (25%, like the other
+    # throughput keys).
+    "serve_disagg_tokens_per_s": Tolerance("higher", 0.25),
+    "serve_kv_migrate_gbps": Tolerance("higher", 0.25),
 }
 
 _TAIL_KV = re.compile(

@@ -28,7 +28,7 @@ timed region (SURVEY.md §7 hard part (b)).
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Callable, Dict, Sequence, Tuple
+from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -627,7 +627,8 @@ def matmul_ring_all_to_all(compute_chunk: Callable, x, axis: str,
 def chunked_ppermute_compute(compute_chunk: Callable, x, axis: str,
                              edges: Sequence[Edge], chunk_dim: int,
                              chunks: int, *, transport: str = "xla",
-                             label: str = "chunked_ppermute_compute"):
+                             label: str = "chunked_ppermute_compute",
+                             kind: Optional[str] = None):
     """Ship ``compute(x)`` over ``edges`` as a *wave* of chunk hops:
     chunk ``c``'s ``ppermute`` is issued the moment its compute
     finishes, so chunk ``c+1``'s compute — and every trailing op with
@@ -666,16 +667,27 @@ def chunked_ppermute_compute(compute_chunk: Callable, x, axis: str,
     kernel's start and wait, the final chunk ships via the plain
     :func:`dma_ppermute`. Same bytes, same chunk order, ledger rows
     ``kind="dma"`` (docs/pallas_dma.md).
+
+    ``kind`` overrides the recorded ledger kind on EVERY hop of the
+    wave (default: the transport's own kind — ``"ppermute"`` /
+    ``"dma"``); the only override today is ``"kv_migrate"``, the
+    serving KV-page migration ship, priced per-link exactly like a
+    ppermute (docs/serving_disagg.md).
     """
     _check_transport(transport)
+    rec_kind = kind if kind is not None else (
+        "dma" if transport == "pallas_dma" else "ppermute")
     edges = tuple((int(s), int(d)) for s, d in edges)
     size = x.shape[chunk_dim]
     chunks = max(1, min(int(chunks), max(1, size)))
     if chunks <= 1:
         # One-shot degrade: ledger-recorded through the same wrapper
         # every other model-layer hop uses, so the rows never drift.
-        ship = dma_ppermute if transport == "pallas_dma" else ppermute
-        return ship(compute_chunk(x, 0), axis, edges, label=label)
+        if transport == "pallas_dma":
+            return dma_ppermute(compute_chunk(x, 0), axis, edges,
+                                label=label, kind=rec_kind)
+        return ppermute(compute_chunk(x, 0), axis, edges, label=label,
+                        kind=rec_kind)
     pad = -(-size // chunks) * chunks - size
     if pad:
         widths = [(0, 0)] * x.ndim
@@ -696,7 +708,7 @@ def chunked_ppermute_compute(compute_chunk: Callable, x, axis: str,
         # in flight while chunk c+1's compute runs in the SAME kernel.
         # Priced by the shipped buffer — the compute OUTPUT, which the
         # XLA path and the final dma_ppermute also record.
-        _record_issue("dma", axis, nbytes=_aval_bytes(y_prev),
+        _record_issue(rec_kind, axis, nbytes=_aval_bytes(y_prev),
                       axis_size=jax.lax.axis_size(axis), edges=edges,
                       count=chunks - 1, label=label)
         for c in range(1, chunks):
@@ -704,7 +716,8 @@ def chunked_ppermute_compute(compute_chunk: Callable, x, axis: str,
                 y_prev, axis, edges,
                 lambda xc, cc=c: compute_chunk(xc, cc), chunk_of(c))
             arrivals.append(arr)
-        arrivals.append(dma_ppermute(y_prev, axis, edges, label=label))
+        arrivals.append(dma_ppermute(y_prev, axis, edges, label=label,
+                                     kind=rec_kind))
     else:
         for c in range(chunks):
             # Compute chunk c, ship it immediately (via the
@@ -712,7 +725,8 @@ def chunked_ppermute_compute(compute_chunk: Callable, x, axis: str,
             # the trailing concat, so chunk c+1's compute (and the
             # caller's remaining tick ops) overlap the transfer.
             arrivals.append(ppermute(compute_chunk(chunk_of(c), c),
-                                     axis, edges, label=label))
+                                     axis, edges, label=label,
+                                     kind=rec_kind))
     out = jnp.concatenate(_promote_vma(arrivals), axis=chunk_dim)
     if pad:
         out = jax.lax.slice_in_dim(out, 0, size, axis=chunk_dim)
@@ -789,18 +803,28 @@ def _fault_throttle(y, axis, edges):
     return y
 
 
-def ppermute(x, axis, edges, *, label: str = "ppermute"):
+def ppermute(x, axis, edges, *, label: str = "ppermute",
+             kind: str = "ppermute"):
     """Ledger-recorded ``jax.lax.ppermute`` — and the fault-injection
-    point for link-degradation plans (:func:`_fault_throttle`)."""
+    point for link-degradation plans (:func:`_fault_throttle`).
+
+    ``kind`` re-files the ledger row under a workload-specific kind
+    that PRICES like a ppermute (per directed link — the only such
+    kind today is ``"kv_migrate"``, the serving KV-page migration
+    ship, docs/serving_disagg.md); the transport stays the same
+    CollectivePermute, and the trace join matches the row against
+    the permute device events (:func:`tpu_p2p.obs.ledger.join_trace`
+    transport aliasing)."""
     edges = tuple((int(s), int(d)) for s, d in edges)
-    _record_issue("ppermute", axis, nbytes=_aval_bytes(x),
+    _record_issue(kind, axis, nbytes=_aval_bytes(x),
                   axis_size=jax.lax.axis_size(axis),
                   edges=edges, label=label)
     return _fault_throttle(jax.lax.ppermute(x, axis, edges), axis,
                            edges)
 
 
-def dma_ppermute(x, axis, edges, *, label: str = "dma_ppermute"):
+def dma_ppermute(x, axis, edges, *, label: str = "dma_ppermute",
+                 kind: str = "dma"):
     """Ledger-recorded raw-DMA ppermute — the ``transport="pallas_dma"``
     twin of :func:`ppermute`: same ``(edges, axis)`` contract, same
     zeros-for-no-arrival semantics, same reverse-edge transpose, but
@@ -809,9 +833,10 @@ def dma_ppermute(x, axis, edges, *, label: str = "dma_ppermute"):
     CollectivePermute. Rows record as ``kind="dma"`` so the obs report
     prices the two transports head-to-head. Callers must sit behind
     ``runtime.pallas_dma_supported()`` (every cache build and the
-    ``--transport`` path does)."""
+    ``--transport`` path does). ``kind`` re-files the row like
+    :func:`ppermute`'s kind does (same per-link pricing)."""
     PD = _require_pallas_dma()
-    _record_issue("dma", axis, nbytes=_aval_bytes(x),
+    _record_issue(kind, axis, nbytes=_aval_bytes(x),
                   axis_size=jax.lax.axis_size(axis),
                   edges=tuple((int(s), int(d)) for s, d in edges),
                   label=label)

@@ -19,6 +19,12 @@ Three layers (ROADMAP "production serving engine", docs/serving.md):
   batcher's lazy page growth, admission/deadline shed verdicts,
   seeded EOS stopping, serve-scoped fault application, and the
   ``serve --chaos`` smoke.
+- :mod:`tpu_p2p.serve.disagg` — disaggregated prefill/decode
+  (docs/serving_disagg.md): a tp-heavy prefill submesh + dp decode
+  replicas with ledger-priced (``kind="kv_migrate"``) KV-page
+  migration between their two page pools, the event-exact dry
+  schedule twin, and the ``serve --disagg`` engine whose token
+  streams are bitwise the colocated engine's.
 """
 
 from tpu_p2p.serve.paged_cache import (  # noqa: F401
@@ -39,6 +45,13 @@ from tpu_p2p.serve.engine import (  # noqa: F401
     serve_mesh,
     synthetic_trace,
 )
+from tpu_p2p.serve.disagg import (  # noqa: F401
+    DisaggBatcher,
+    KvMigrator,
+    build_disagg_meshes,
+    run_disagg_engine,
+    simulate_disagg_schedule,
+)
 from tpu_p2p.serve.resilience import (  # noqa: F401
     OUTCOME_COMPLETED,
     OUTCOME_SHED_ADMISSION,
@@ -50,6 +63,11 @@ from tpu_p2p.serve.resilience import (  # noqa: F401
 
 __all__ = [
     "Batcher",
+    "DisaggBatcher",
+    "KvMigrator",
+    "build_disagg_meshes",
+    "run_disagg_engine",
+    "simulate_disagg_schedule",
     "OUTCOME_COMPLETED",
     "OUTCOME_SHED_ADMISSION",
     "OUTCOME_SHED_DEADLINE",

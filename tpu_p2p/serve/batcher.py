@@ -102,6 +102,20 @@ class Request:
     preempt_recover_steps: List[int] = dataclasses.field(
         default_factory=list)
     pending_preempt_step: Optional[int] = None
+    # Disaggregated lifecycle (round 18, docs/serving_disagg.md):
+    # which page pool currently/last held the request's KV ("kv" on
+    # the colocated engine), when its prefill completed on the
+    # prefill submesh, when its pages migrated to the decode side,
+    # which decode shard took it, and how many blocks each migration
+    # shipped. migrate_wait_steps (migrate − prefill_done, worst
+    # episode) is what `obs watch --max-migrate-wait-steps` alerts on.
+    pool: str = "kv"
+    prefill_done_step: Optional[int] = None
+    migrate_step: Optional[int] = None
+    migrate_wait_steps: Optional[int] = None
+    decode_shard: Optional[int] = None
+    migrated_blocks: int = 0
+    migrations: int = 0
 
     @property
     def n_prompt(self) -> int:
@@ -145,6 +159,57 @@ class _Slot:
         self.prefill_len = prefill_len
 
 
+def build_slot_inputs(slots, chunk: int, next_tokens):
+    """The mixed step's host-side input triple off a slot bank:
+    ``(tokens [B, chunk], pos [B], n_active [B])`` — one row per slot,
+    prefill rows carrying their next prompt slice, decode rows their
+    last generated id, idle rows zeros. Factored out of
+    :meth:`Batcher._build_inputs` (round 18) so the disaggregated
+    batcher's two slot banks (prefill-side and decode-side —
+    tpu_p2p/serve/disagg.py) build their step inputs through the ONE
+    definition the colocated engine uses; ``next_tokens(slot)`` is
+    the caller's phase policy."""
+    c = chunk
+    n_slots = len(slots)
+    tokens = np.zeros((n_slots, c), np.int32)
+    pos = np.zeros(n_slots, np.int32)
+    n_active = np.zeros(n_slots, np.int32)
+    for i, s in enumerate(slots):
+        if s is None:
+            continue
+        pos[i] = s.pos
+        n = next_tokens(s)
+        if s.phase == "prefill":
+            src = s.req.full_tokens()
+            tokens[i, :n] = src[s.pos:s.pos + n]
+        else:
+            tokens[i, 0] = s.req.generated[-1]
+        n_active[i] = n
+    return tokens, pos, n_active
+
+
+def place_step_inputs(mesh, tokens, pos, n_active, tables):
+    """Host arrays → device, sharded like the mixed step's in_specs
+    (slots/tables over the mesh's dp/ep rows). Factored out of
+    :meth:`Batcher._place` (round 18) for the same reuse reason as
+    :func:`build_slot_inputs`."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_p2p.models.flagship import _axis
+
+    dp = _axis(mesh, "dp")
+    epx = _axis(mesh, "ep")
+    rows = tuple(a for a in (dp, epx) if a is not None) or None
+    mat = NamedSharding(mesh, P(rows, None))
+    vec = NamedSharding(mesh, P(rows))
+    return (jax.device_put(jnp.asarray(tokens), mat),
+            jax.device_put(jnp.asarray(pos), vec),
+            jax.device_put(jnp.asarray(n_active), vec),
+            jax.device_put(jnp.asarray(tables), mat))
+
+
 class Batcher:
     """Slot state + queue over the mixed step. ``dry=True`` builds no
     device program and records the schedule instead (tokens for
@@ -171,6 +236,7 @@ class Batcher:
                  eos_prob: float = 0.0,
                  pool_clamp: Optional[int] = None,
                  step_hook: Optional[Callable[[int], None]] = None,
+                 pool_name: str = "kv",
                  clock: Callable[[], float] = time.monotonic) -> None:
         if mode not in BATCHING_MODES:
             raise ValueError(
@@ -211,7 +277,8 @@ class Batcher:
         self.eos_prob = eos_prob
         self.step_hook = step_hook
         self.clock = clock
-        self.pool_alloc = PagePool(num_pages, page_len, n_shards)
+        self.pool_alloc = PagePool(num_pages, page_len, n_shards,
+                                   name=pool_name)
         if pool_clamp is not None:
             self.pool_alloc.clamp_capacity(pool_clamp)
         self.queue: deque = deque()
@@ -315,6 +382,7 @@ class Batcher:
                 # another free slot may live on a shard with pages.
                 continue
             self.queue.popleft()
+            req.pool = self.pool_alloc.name
             self.slots[i] = _Slot(req, pages, prefill_len)
             row = np.full(self.max_blocks, TRASH_PAGE, np.int32)
             row[:blocks0] = pages
@@ -381,22 +449,8 @@ class Batcher:
                 self.tables[i, len(s.pages) - 1] = pid
 
     def _build_inputs(self):
-        c = self.chunk
-        tokens = np.zeros((self.slots_n, c), np.int32)
-        pos = np.zeros(self.slots_n, np.int32)
-        n_active = np.zeros(self.slots_n, np.int32)
-        for i, s in enumerate(self.slots):
-            if s is None:
-                continue
-            pos[i] = s.pos
-            n = self._next_tokens(s)
-            if s.phase == "prefill":
-                src = s.req.full_tokens()
-                tokens[i, :n] = src[s.pos:s.pos + n]
-            else:
-                tokens[i, 0] = s.req.generated[-1]
-            n_active[i] = n
-        return tokens, pos, n_active
+        return build_slot_inputs(self.slots, self.chunk,
+                                 self._next_tokens)
 
     def _stop_after(self, req: Request) -> bool:
         """Finished after the token just appended? Length-driven by
@@ -488,21 +542,8 @@ class Batcher:
 
     def _place(self, tokens, pos, n_active):
         """Host arrays → device, sharded like the step's in_specs."""
-        import jax
-        import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from tpu_p2p.models.flagship import _axis
-
-        dp = _axis(self.mesh, "dp")
-        epx = _axis(self.mesh, "ep")
-        rows = tuple(a for a in (dp, epx) if a is not None) or None
-        mat = NamedSharding(self.mesh, P(rows, None))
-        vec = NamedSharding(self.mesh, P(rows))
-        return (jax.device_put(jnp.asarray(tokens), mat),
-                jax.device_put(jnp.asarray(pos), vec),
-                jax.device_put(jnp.asarray(n_active), vec),
-                jax.device_put(jnp.asarray(self.tables), mat))
+        return place_step_inputs(self.mesh, tokens, pos, n_active,
+                                 self.tables)
 
     def run(self, trace: List[Request]) -> List[Request]:
         """Drive a whole step-indexed trace to completion; → finished

@@ -1,0 +1,986 @@
+"""Disaggregated prefill/decode serving — KV-page migration as
+ledger-priced p2p transport (docs/serving_disagg.md).
+
+The colocated continuous batcher (:mod:`tpu_p2p.serve.batcher`) runs
+every slot's chunked prefill AND single-token decode inside ONE mixed
+step on one mesh — so a burst of long prompts steals step time from
+every in-flight decode. DistServe (Zhong et al., OSDI 2024) and
+Splitwise (Patel et al., ISCA 2024) showed the two phases want
+different hardware shapes: prefill is compute-bound (tensor-parallel
+over many chips shortens a long prompt's latency), decode is
+bandwidth-bound (independent replicas maximize aggregate token
+cadence). This module partitions the device set accordingly
+(``ServeConfig.disagg``, :func:`build_disagg_meshes`):
+
+- **prefill submesh** ``1 × tp`` — chunked prefill ONLY, KV heads
+  sharded over tp, its own :class:`~tpu_p2p.serve.paged_cache.
+  PagePool` tagged ``"prefill"``;
+- **decode submesh** ``dp`` replicas — single-token decode ONLY, its
+  own page pool tagged ``"decode"``, slots pinned to replica shards;
+- **migration**: when a request's prefill completes (and its first
+  token is emitted from the last chunk's logits), its resident KV
+  pages move prefill → decode as an EXPLICIT instrumented p2p
+  transfer (:class:`KvMigrator`): each prefill shard's head-slice
+  ships over its own directed link ``(prefill_rank → decode_rank)``
+  through :func:`tpu_p2p.parallel.collectives.
+  chunked_ppermute_compute` — the same lowering (and the same
+  ``transport="xla"|"pallas_dma"`` knob) as every other hop in the
+  repo — recorded as ``kind="kv_migrate"`` ledger rows priced
+  per-link like ppermute, so ``python -m tpu_p2p obs`` and the
+  ``MULTICHIP_r*.json`` matrix see migration traffic as first-class
+  per-link load. The N×N bandwidth matrix the paper measures becomes
+  a routing input: migration exercises exactly the prefill×decode
+  bipartite links.
+
+Decode steps never stall on a long prompt BY CONSTRUCTION: the decode
+submesh's mixed step only ever sees ``n_active <= 1`` rows. Completed
+prefills wait in a FIFO **migration queue** until a decode shard has
+a free slot and pages; the wait is surfaced per request
+(``migrate_wait_steps`` — ``obs watch --max-migrate-wait-steps``
+alerts on it). A decode-side preemption (pool exhaustion under lazy
+growth) re-enqueues the victim at the PREFILL queue head with its
+generated ids riding as prompt extension — zero completed-token loss,
+the same contract as the colocated engine
+(docs/serving_resilience.md).
+
+Scheduling stays length-driven, so :func:`simulate_disagg_schedule`
+is the device-free event-exact twin: the per-step inputs of BOTH
+submeshes, every migration event, every preemption/shed verdict —
+replayable and pinned dry == real (tests/test_serve_disagg.py).
+
+Token parity is the load-bearing pin: every completed request's
+token stream is BITWISE the colocated engine's (the shared
+:func:`tpu_p2p.models.decode._attend_ffn` body is the parity anchor —
+same chunk schedule on the prefill side, same single-token decode on
+the decode side, migration moves bytes verbatim).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from tpu_p2p.serve.batcher import (
+    Request,
+    _Slot,
+    build_slot_inputs,
+    percentile,
+    place_step_inputs,
+)
+from tpu_p2p.serve.paged_cache import (
+    OutOfPages,
+    PagePool,
+    TRASH_PAGE,
+    init_paged_pool,
+    make_paged_lm_step,
+)
+from tpu_p2p.serve.resilience import (
+    OUTCOME_COMPLETED,
+    OUTCOME_SHED_ADMISSION,
+    OUTCOME_SHED_DEADLINE,
+    choose_victim,
+    eos_stop,
+)
+
+__all__ = [
+    "build_disagg_meshes",
+    "KvMigrator",
+    "DisaggBatcher",
+    "simulate_disagg_schedule",
+    "run_disagg_engine",
+]
+
+
+def build_disagg_meshes(prefill_tp: int = 0, devices=None):
+    """Partition the visible devices into the disagg submeshes —
+    validated like ``build_mesh`` validates an axis factorization:
+    → ``(prefill_mesh (1×tp), decode_mesh (dp replicas), mig_mesh
+    (one 'mig' axis over ALL devices, prefill ranks first))``.
+
+    ``prefill_tp`` is the prefill submesh's tp size AND its device
+    count (the submesh is ``1 × tp`` by construction — tp-heavy is
+    the point); 0 = auto, half the devices. The mig mesh's rank
+    order (prefill devices then decode devices, in ``jax.devices()``
+    order) is the migration ledger's edge numbering, so the
+    ``MULTICHIP`` matrix cells line up with the global device ids.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n < 2:
+        raise ValueError(
+            f"disagg needs >= 2 devices (a prefill submesh AND a "
+            f"decode submesh), got {n}"
+        )
+    p = int(prefill_tp) if prefill_tp else max(1, n // 2)
+    if not 1 <= p <= n - 1:
+        raise ValueError(
+            f"prefill_tp ({p}) must partition {n} devices into a "
+            f"1×tp prefill submesh and >= 1 decode replica "
+            f"(1 <= prefill_tp <= {n - 1})"
+        )
+    prefill = Mesh(np.array(devices[:p]).reshape(1, p), ("dp", "tp"))
+    decode = Mesh(np.array(devices[p:n]).reshape(n - p), ("dp",))
+    mig = Mesh(np.array(devices[:n]).reshape(n), ("mig",))
+    return prefill, decode, mig
+
+
+class KvMigrator:
+    """Compiled KV-page migration: prefill pool pages → decode pool
+    pages over explicit per-link p2p ships.
+
+    One migration of a ``blocks``-page resident set is three compiled
+    pieces (cached per shape, so a serving run compiles each once):
+
+    1. **extract** (prefill mesh): gather the request's pages out of
+       the prefill pool — ``[stages, blocks, H_kv, page_len, Dh]``
+       with KV heads still sharded over prefill tp. Pure local
+       gathers, no transport.
+    2. **ship** (mig mesh, the instrumented transport): each prefill
+       rank's head-slice ships to the target decode rank through
+       :func:`~tpu_p2p.parallel.collectives.chunked_ppermute_compute`
+       with ``kind="kv_migrate"`` — one directed edge per prefill
+       shard per tensor, ``migrate_chunks`` wave hops each, lowered
+       over ``transport="xla"`` (CollectivePermute) or
+       ``"pallas_dma"`` (raw async remote copies). The arrivals
+       concatenate back to full heads on the destination rank; every
+       other rank holds zeros (the ppermute no-arrival contract).
+       Per-device staging in and out of the mig mesh is assembled
+       with ``jax.make_array_from_single_device_arrays`` — a
+       zero-copy relabel of buffers already resident on the right
+       device, so EVERY cross-device byte of a migration crosses
+       inside the recorded ships.
+    3. **deposit** (decode mesh): scatter the full-head block into
+       the destination shard's freshly allocated pool pages (other
+       shards write zeros to their trash page — the no-op write
+       convention). The pool is donated, like the mixed step's.
+    """
+
+    def __init__(self, prefill_mesh, decode_mesh, mig_mesh, cfg, *,
+                 page_len: int, transport: str = "xla",
+                 chunks: int = 1) -> None:
+        from tpu_p2p.config import TRANSPORTS
+
+        if transport not in TRANSPORTS:
+            raise ValueError(
+                f"unknown transport {transport!r}; expected one of "
+                f"{TRANSPORTS}"
+            )
+        if transport == "pallas_dma":
+            from tpu_p2p.parallel.runtime import (
+                pallas_dma_probe_error,
+                pallas_dma_supported,
+            )
+
+            if not pallas_dma_supported():
+                raise RuntimeError(
+                    "transport='pallas_dma' migration needs the raw-"
+                    "DMA capability probe to pass: "
+                    f"{pallas_dma_probe_error()}"
+                )
+        self.prefill_mesh = prefill_mesh
+        self.decode_mesh = decode_mesh
+        self.mig_mesh = mig_mesh
+        self.cfg = cfg
+        self.page_len = int(page_len)
+        self.transport = transport
+        self.chunks = max(1, int(chunks))
+        self.n_prefill = int(np.prod(prefill_mesh.devices.shape))
+        self.n_decode = int(np.prod(decode_mesh.devices.shape))
+        self._extracts: Dict[int, Callable] = {}
+        self._ships: Dict[tuple, Callable] = {}
+        self._deposits: Dict[int, Callable] = {}
+        # Per-device lookup for the zero-copy mig/decode staging, and
+        # a cache of the constant zero padding rows (shape/dtype/
+        # device-invariant across migrations; the ship reads them
+        # without donation, so one upload serves every migration).
+        self._mig_devices = list(mig_mesh.devices.flat)
+        self._dec_devices = list(decode_mesh.devices.flat)
+        self._zero_rows: Dict[tuple, object] = {}
+
+    # ------------------------------------------------------- programs
+
+    def block_bytes(self, blocks: int) -> int:
+        """Wire bytes one migration of ``blocks`` pages ships (K and
+        V, full heads — the sum over the per-link head-slices)."""
+        import jax.numpy as jnp
+
+        itemsize = jnp.dtype(self.cfg.dtype).itemsize
+        return (2 * self.cfg.stages * int(blocks)
+                * self.cfg.num_kv_heads * self.page_len
+                * self.cfg.head_dim * itemsize)
+
+    def _extract(self, blocks: int):
+        fn = self._extracts.get(blocks)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from tpu_p2p.models.flagship import _axis
+
+            tp = _axis(self.prefill_mesh, "tp")
+            out_sh = NamedSharding(self.prefill_mesh,
+                                   P(None, None, tp, None, None))
+
+            def f(pk, pv, pages):
+                return (jnp.take(pk, pages, axis=1),
+                        jnp.take(pv, pages, axis=1))
+
+            fn = jax.jit(f, out_shardings=(out_sh, out_sh))
+            self._extracts[blocks] = fn
+        return fn
+
+    def _ship(self, blocks: int, dst_rank: int):
+        key = (blocks, int(dst_rank))
+        fn = self._ships.get(key)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import PartitionSpec as P
+
+            from tpu_p2p.parallel import collectives as C
+
+            srcs = tuple(range(self.n_prefill))
+            label = f"kv_migrate:{self.transport}"
+
+            def body(bk, bv):
+                outs = []
+                for b in (bk, bv):
+                    x = b[0]  # [stages, blocks, H_loc, L, Dh]
+                    parts = [
+                        C.chunked_ppermute_compute(
+                            lambda c, _i: c, x, "mig",
+                            ((src, int(dst_rank)),),
+                            chunk_dim=3, chunks=self.chunks,
+                            transport=self.transport,
+                            label=label, kind="kv_migrate")
+                        for src in srcs
+                    ]
+                    outs.append(jnp.concatenate(parts, axis=2)[None])
+                return tuple(outs)
+
+            sm = jax.shard_map(
+                body, mesh=self.mig_mesh,
+                in_specs=(P("mig"), P("mig")),
+                out_specs=(P("mig"), P("mig")),
+            )
+            fn = jax.jit(sm)
+            self._ships[key] = fn
+        return fn
+
+    def _deposit(self, blocks: int):
+        fn = self._deposits.get(blocks)
+        if fn is None:
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            from tpu_p2p.serve.paged_cache import paged_pool_spec
+
+            c_spec = paged_pool_spec(self.decode_mesh)
+
+            def body(pk, pv, bk, bv, pages):
+                pg = pages[0]
+                pk = pk.at[:, pg].set(bk[0].astype(pk.dtype))
+                pv = pv.at[:, pg].set(bv[0].astype(pv.dtype))
+                return pk, pv
+
+            sm = jax.shard_map(
+                body, mesh=self.decode_mesh,
+                in_specs=(c_spec, c_spec, P("dp"), P("dp"),
+                          P("dp", None)),
+                out_specs=(c_spec, c_spec),
+            )
+            fn = jax.jit(sm, donate_argnums=(0, 1))
+            self._deposits[blocks] = fn
+        return fn
+
+    def _to_mig_rows(self, x):
+        """tp-head-sharded prefill block → the ``[n_mig, ...]``
+        row-sharded mig payload, zero-copy: prefill shards relabel in
+        place (their head-slice IS row ``rank``), decode rows are
+        locally created zeros."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        per = {s.device: s.data for s in x.addressable_shards}
+        row_shape = None
+        rows = []
+        for r, dev in enumerate(self._mig_devices):
+            if dev in per:
+                piece = per[dev][None]
+                row_shape = piece.shape
+            else:
+                key = (row_shape, np.dtype(x.dtype).str, r)
+                piece = self._zero_rows.get(key)
+                if piece is None:
+                    piece = jax.device_put(
+                        np.zeros(row_shape, dtype=x.dtype), dev)
+                    self._zero_rows[key] = piece
+            rows.append(piece)
+        shape = (len(rows),) + tuple(row_shape[1:])
+        sharding = NamedSharding(self.mig_mesh, P("mig"))
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, rows)
+
+    def _to_decode_rows(self, out):
+        """Shipped ``[n_mig, ...]`` buffer → its decode-row slice as
+        a decode-mesh array, zero-copy (each decode device's row is
+        already resident there)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        per = {s.device: s.data for s in out.addressable_shards}
+        rows = [per[d] for d in self._dec_devices]
+        shape = (len(rows),) + tuple(out.shape[1:])
+        sharding = NamedSharding(self.decode_mesh, P("dp"))
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, rows)
+
+    # -------------------------------------------------------- migrate
+
+    def migrate(self, pre_pool, prefill_pages: List[int], dec_pool,
+                dec_pages: List[int], dst_shard: int):
+        """Move one request's resident KV pages across: → the updated
+        (donated) decode pool. ``prefill_pages``/``dec_pages`` are
+        the shard-local page indices on each side (same length)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        blocks = len(prefill_pages)
+        assert len(dec_pages) == blocks
+        bk, bv = self._extract(blocks)(
+            pre_pool["k"], pre_pool["v"],
+            jnp.asarray(prefill_pages, jnp.int32))
+        bufk = self._to_mig_rows(bk)
+        bufv = self._to_mig_rows(bv)
+        outk, outv = self._ship(blocks,
+                                self.n_prefill + int(dst_shard))(
+            bufk, bufv)
+        rk = self._to_decode_rows(outk)
+        rv = self._to_decode_rows(outv)
+        pages_arr = np.full((self.n_decode, blocks), TRASH_PAGE,
+                            np.int32)
+        pages_arr[dst_shard] = dec_pages
+        pages_dev = jax.device_put(
+            jnp.asarray(pages_arr),
+            NamedSharding(self.decode_mesh, P("dp", None)))
+        k2, v2 = self._deposit(blocks)(
+            dec_pool["k"], dec_pool["v"], rk, rv, pages_dev)
+        jax.block_until_ready(k2)
+        return {"k": k2, "v": v2}
+
+
+class DisaggBatcher:
+    """Two slot banks, two page pools, one scheduler step.
+
+    Per engine step: shed expired, admit the queue into PREFILL
+    slots (pages for the prefill's resident set reserved up front —
+    prefill never grows), grow/preempt DECODE tables (a victim
+    re-enqueues to the prefill queue head with zero token loss), run
+    both mixed steps, advance both banks (a completing prefill emits
+    its first token and enters the migration queue; a decode slot
+    emits one token), then drain the migration queue FIFO into decode
+    shards with a free slot and pages (head-of-line strict, so the
+    dry twin's order is trivially deterministic).
+
+    ``dry=True`` builds no device program (meshes/params may be
+    None) and the SAME event trace records — scheduling is
+    length-driven, so dry == real is event-exact
+    (:func:`simulate_disagg_schedule`).
+    """
+
+    def __init__(self, prefill_mesh, decode_mesh, mig_mesh, cfg,
+                 params_prefill, params_decode, *, slots: int,
+                 prefill_slots: int, page_len: int, num_pages: int,
+                 prefill_pages: int, max_blocks: int, chunk: int,
+                 dry: bool = False, n_decode_shards: Optional[int] = None,
+                 queue_depth: int = 0, deadline_steps: int = 0,
+                 stop: str = "length", stop_seed: int = 0,
+                 eos_prob: float = 0.0,
+                 pool_clamp: Optional[int] = None,
+                 step_hook: Optional[Callable[[int], None]] = None,
+                 transport: str = "xla", migrate_chunks: int = 1,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        from tpu_p2p.config import SERVE_STOPS
+
+        if stop not in SERVE_STOPS:
+            raise ValueError(
+                f"unknown stop rule {stop!r}; expected one of "
+                f"{SERVE_STOPS}"
+            )
+        if stop == "eos" and not 0.0 < eos_prob < 1.0:
+            raise ValueError(
+                f"stop='eos' needs eos_prob in (0, 1), got {eos_prob}"
+            )
+        if n_decode_shards is None:
+            if decode_mesh is None:
+                raise ValueError(
+                    "dry DisaggBatcher needs n_decode_shards"
+                )
+            n_decode_shards = int(np.prod(decode_mesh.devices.shape))
+        if slots % n_decode_shards:
+            raise ValueError(
+                f"decode slots ({slots}) must divide by the decode "
+                f"replica count ({n_decode_shards})"
+            )
+        if prefill_slots <= 0:
+            raise ValueError("prefill_slots must be positive")
+        self.cfg = cfg
+        self.prefill_mesh, self.decode_mesh = prefill_mesh, decode_mesh
+        self.slots_n, self.prefill_slots_n = slots, prefill_slots
+        self.page_len, self.max_blocks = page_len, max_blocks
+        self.chunk, self.dry = chunk, dry
+        self.n_dec = n_decode_shards
+        self.queue_depth = queue_depth
+        self.deadline_steps = deadline_steps
+        self.stop, self.stop_seed = stop, stop_seed
+        self.eos_prob = eos_prob
+        self.step_hook = step_hook
+        self.clock = clock
+        # Two pools, two identities (the round-18 satellite): a
+        # prefill-side exhaustion message must not read like a
+        # decode-side one.
+        self.pool_p = PagePool(prefill_pages, page_len, 1,
+                               name="prefill")
+        self.pool_d = PagePool(num_pages, page_len, n_decode_shards,
+                               name="decode")
+        if pool_clamp is not None:
+            # The page_pool_clamp fault clamps the DECODE pool — the
+            # side whose lazy growth the preemption path defends.
+            self.pool_d.clamp_capacity(pool_clamp)
+        self.queue: deque = deque()
+        self.mq: deque = deque()      # migration queue (FIFO)
+        self.slots_p: List[Optional[_Slot]] = [None] * prefill_slots
+        self.slots_d: List[Optional[_Slot]] = [None] * slots
+        self.tables_p = np.zeros((prefill_slots, max_blocks), np.int32)
+        self.tables_d = np.zeros((slots, max_blocks), np.int32)
+        self.step_idx = 0
+        self.idle_steps = 0
+        self.finished: List[Request] = []
+        self.shed: List[Request] = []
+        self.preempt_events: List[Dict] = []
+        self.migrate_events: List[Dict] = []
+        self.events: List[Dict] = []
+        self.kv_migrate_bytes = 0
+        self.migrate_wall_s = 0.0
+        if not dry:
+            self._step_p = make_paged_lm_step(
+                prefill_mesh, cfg, page_len=page_len,
+                max_blocks=max_blocks, chunk=chunk)
+            self._step_d = make_paged_lm_step(
+                decode_mesh, cfg, page_len=page_len,
+                max_blocks=max_blocks, chunk=chunk)
+            self.pre_pool = init_paged_pool(cfg, prefill_pages,
+                                            page_len, prefill_mesh)
+            self.dec_pool = init_paged_pool(cfg, num_pages, page_len,
+                                            decode_mesh)
+            self.params_p, self.params_d = params_prefill, params_decode
+            self.migrator = KvMigrator(
+                prefill_mesh, decode_mesh, mig_mesh, cfg,
+                page_len=page_len, transport=transport,
+                chunks=migrate_chunks)
+        else:
+            self._step_p = self._step_d = None
+            self.pre_pool = self.dec_pool = None
+            self.params_p = self.params_d = None
+            # A dry migrator twin for byte accounting only.
+            self.migrator = None
+            self._dry_block_bytes = (
+                2 * cfg.stages * cfg.num_kv_heads * page_len
+                * cfg.head_dim * np.dtype(cfg.dtype).itemsize
+                if cfg is not None else 0)
+
+    # ------------------------------------------------------ scheduling
+
+    def _block_bytes(self, blocks: int) -> int:
+        if self.migrator is not None:
+            return self.migrator.block_bytes(blocks)
+        return self._dry_block_bytes * int(blocks)
+
+    def _shard_of_d(self, slot: int) -> int:
+        return slot // (self.slots_n // self.n_dec)
+
+    def _shed(self, req: Request, outcome: str) -> None:
+        req.outcome = outcome
+        req.shed_step = self.step_idx
+        self.shed.append(req)
+
+    def submit(self, req: Request) -> bool:
+        """Same admission contract as the colocated batcher: bounded
+        queue sheds the newcomer, deadlines start counting at
+        enqueue."""
+        req.enqueue_step = self.step_idx
+        req.t_enqueue = self.clock()
+        if self.deadline_steps and req.deadline_step is None:
+            req.deadline_step = self.step_idx + self.deadline_steps
+        if self.queue_depth and len(self.queue) >= self.queue_depth:
+            self._shed(req, OUTCOME_SHED_ADMISSION)
+            return False
+        self.queue.append(req)
+        return True
+
+    def idle(self) -> bool:
+        return (not self.queue and not self.mq
+                and all(s is None for s in self.slots_p)
+                and all(s is None for s in self.slots_d))
+
+    def _shed_expired(self) -> None:
+        """Deadline pass over the ADMISSION queue only — requests in
+        the migration queue or either slot bank are in flight (the
+        zero-loss contract exempts them, exactly like the colocated
+        batcher exempts mid-service requests)."""
+        if not self.deadline_steps:
+            return
+        kept: deque = deque()
+        for r in self.queue:
+            if (r.deadline_step is not None
+                    and r.prefill_start_step is None
+                    and self.step_idx > r.deadline_step):
+                self._shed(r, OUTCOME_SHED_DEADLINE)
+            else:
+                kept.append(r)
+        self.queue = kept
+
+    def _admit(self) -> None:
+        self._shed_expired()
+        for i in range(self.prefill_slots_n):
+            if not self.queue:
+                return
+            if self.slots_p[i] is not None:
+                continue
+            req = self.queue[0]
+            blocks = req.blocks_needed(self.page_len)
+            if blocks > self.max_blocks:
+                raise ValueError(
+                    f"request {req.rid}: {blocks} blocks exceed the "
+                    f"step's max_blocks={self.max_blocks} window"
+                )
+            if blocks > self.pool_d.capacity:
+                raise ValueError(
+                    f"request {req.rid}: needs {blocks} pages but a "
+                    f"decode shard owns only {self.pool_d.capacity} "
+                    "— it could never finish decoding"
+                )
+            prefill_len = req.n_prompt + len(req.generated)
+            blocks0 = max(1, -(-prefill_len // self.page_len))
+            if blocks0 > self.pool_p.capacity:
+                raise ValueError(
+                    f"request {req.rid}: prefill needs {blocks0} "
+                    f"pages but the prefill pool owns only "
+                    f"{self.pool_p.capacity} — it could never prefill"
+                )
+            try:
+                pages = self.pool_p.alloc_n(blocks0, 0)
+            except OutOfPages:
+                # Prefill pool fully occupied (active prefills +
+                # migration-queue holds): admission stalls until the
+                # decode side drains a migration.
+                return
+            self.queue.popleft()
+            req.pool = self.pool_p.name
+            self.slots_p[i] = _Slot(req, pages, prefill_len)
+            row = np.full(self.max_blocks, TRASH_PAGE, np.int32)
+            row[:blocks0] = pages
+            self.tables_p[i] = row
+
+    def _next_tokens_p(self, s: _Slot) -> int:
+        return min(self.chunk, s.prefill_len - s.pos)
+
+    def _next_tokens_d(self, s: _Slot) -> int:
+        return 1
+
+    def _preempt_decode(self, i: int) -> None:
+        """Evict decode slot ``i`` and re-enqueue its request at the
+        PREFILL queue head: the generated ids ride as prompt
+        extension (``Request.full_tokens``), so recompute happens on
+        the prefill submesh and no completed token is lost."""
+        s = self.slots_d[i]
+        req = s.req
+        self.pool_d.free(s.pages, self._shard_of_d(i))
+        self.tables_d[i] = TRASH_PAGE
+        self.slots_d[i] = None
+        req.preemptions += 1
+        req.preempt_steps.append(self.step_idx)
+        if req.pending_preempt_step is None:
+            req.pending_preempt_step = self.step_idx
+        self.preempt_events.append({
+            "rid": req.rid, "step": self.step_idx,
+            "generated": len(req.generated), "side": "decode",
+        })
+        req.pool = self.pool_p.name
+        self.queue.appendleft(req)
+
+    def _grow_decode(self) -> None:
+        """Lazy decode-side page growth with preemption-on-exhaustion
+        — the colocated batcher's `_grow_tables` against the decode
+        pool, with the victim re-entering PREFILL."""
+        for i in range(self.slots_n):
+            s = self.slots_d[i]
+            if s is None:
+                continue
+            need = (s.pos + 1 - 1) // self.page_len + 1
+            shard = self._shard_of_d(i)
+            while self.slots_d[i] is s and len(s.pages) < need:
+                try:
+                    pid = self.pool_d.alloc(shard)
+                except OutOfPages:
+                    victim = choose_victim(self.slots_d, shard,
+                                           self._shard_of_d)
+                    if victim is None:  # unreachable: slot i occupies
+                        raise
+                    self._preempt_decode(victim)
+                    continue
+                s.pages.append(pid)
+                self.tables_d[i, len(s.pages) - 1] = pid
+
+    def _stop_after(self, req: Request) -> bool:
+        k = len(req.generated)
+        if k >= req.max_new:
+            return True
+        return (self.stop == "eos"
+                and eos_stop(self.stop_seed, req.rid, k,
+                             self.eos_prob))
+
+    def _choose_decode_shard(self, blocks: int) -> Optional[int]:
+        """Deterministic placement off dry-visible state alone: the
+        shard with a free slot AND ``blocks`` free pages, most free
+        pages first, ties to the lowest shard index."""
+        best = None
+        for shard in range(self.n_dec):
+            has_slot = any(
+                self.slots_d[i] is None
+                for i in range(self.slots_n)
+                if self._shard_of_d(i) == shard)
+            if not has_slot:
+                continue
+            free = self.pool_d.available(shard)
+            if free < blocks:
+                continue
+            key = (-free, shard)
+            if best is None or key < best[0]:
+                best = (key, shard)
+        return best[1] if best is not None else None
+
+    def _finish(self, req: Request, now: float) -> None:
+        req.t_finish = now
+        req.finish_step = self.step_idx
+        req.outcome = OUTCOME_COMPLETED
+        self.finished.append(req)
+
+    def _drain_migrations(self, now: float) -> List[Dict]:
+        """FIFO drain of completed prefills into decode slots; → the
+        migration events performed this step. Strict head-of-line:
+        the first entry that cannot place (no shard with a free slot
+        + pages) blocks the rest — deterministic, starvation-free."""
+        performed = []
+        while self.mq:
+            entry = self.mq[0]
+            req, pages = entry["req"], entry["pages"]
+            blocks = len(pages)
+            shard = self._choose_decode_shard(blocks)
+            if shard is None:
+                break
+            self.mq.popleft()
+            slot_i = next(
+                i for i in range(self.slots_n)
+                if self.slots_d[i] is None
+                and self._shard_of_d(i) == shard)
+            dec_pages = self.pool_d.alloc_n(blocks, shard)
+            if not self.dry:
+                t0 = self.clock()
+                self.dec_pool = self.migrator.migrate(
+                    self.pre_pool, pages, self.dec_pool, dec_pages,
+                    shard)
+                self.migrate_wall_s += self.clock() - t0
+            self.pool_p.free(pages, 0)
+            s = _Slot(req, dec_pages, entry["prefill_len"])
+            s.pos = entry["prefill_len"]
+            s.phase = "decode"
+            self.slots_d[slot_i] = s
+            row = np.full(self.max_blocks, TRASH_PAGE, np.int32)
+            row[:blocks] = dec_pages
+            self.tables_d[slot_i] = row
+            wait = self.step_idx - entry["done_step"]
+            req.pool = self.pool_d.name
+            req.migrate_step = self.step_idx
+            req.migrate_wait_steps = max(req.migrate_wait_steps or 0,
+                                         wait)
+            req.decode_shard = shard
+            req.migrated_blocks += blocks
+            req.migrations += 1
+            self.kv_migrate_bytes += self._block_bytes(blocks)
+            ev = {"rid": req.rid, "step": self.step_idx,
+                  "blocks": blocks, "dst_shard": shard,
+                  "wait_steps": wait}
+            self.migrate_events.append(ev)
+            performed.append(ev)
+        return performed
+
+    # ------------------------------------------------------- stepping
+
+    def step(self) -> List[Request]:
+        """One engine step over BOTH submeshes; → requests finished
+        this step."""
+        self._admit()
+        self._grow_decode()
+        tok_p, pos_p, act_p = build_slot_inputs(
+            self.slots_p, self.chunk, self._next_tokens_p)
+        tok_d, pos_d, act_d = build_slot_inputs(
+            self.slots_d, self.chunk, self._next_tokens_d)
+        busy_p, busy_d = int(act_p.sum()), int(act_d.sum())
+        if not busy_p and not busy_d and not self.mq:
+            self.idle_steps += 1
+            self.step_idx += 1
+            return []
+        if self.step_hook is not None:
+            self.step_hook(self.step_idx)
+        now = self.clock()
+        for s in self.slots_p:
+            if s is not None and s.pos == 0 \
+                    and s.req.t_prefill_start is None:
+                s.req.t_prefill_start = now
+                s.req.prefill_start_step = self.step_idx
+        logits_p = logits_d = None
+        if not self.dry:
+            import jax
+
+            if busy_p:
+                self.pre_pool, logits_p = self._step_p(
+                    self.params_p, self.pre_pool,
+                    *place_step_inputs(self.prefill_mesh, tok_p,
+                                       pos_p, act_p, self.tables_p))
+                logits_p = np.asarray(jax.device_get(logits_p))
+            if busy_d:
+                self.dec_pool, logits_d = self._step_d(
+                    self.params_d, self.dec_pool,
+                    *place_step_inputs(self.decode_mesh, tok_d,
+                                       pos_d, act_d, self.tables_d))
+                logits_d = np.asarray(jax.device_get(logits_d))
+        done: List[Request] = []
+        now = self.clock()
+        # Prefill bank: completing slots emit their FIRST token off
+        # the last chunk's logits, then queue for migration (pages
+        # stay resident in the prefill pool until the move).
+        for i, s in enumerate(self.slots_p):
+            if s is None:
+                continue
+            req, n = s.req, int(act_p[i])
+            s.pos += n
+            if s.pos < s.prefill_len:
+                continue
+            tok = (int(np.argmax(logits_p[i, n - 1]))
+                   if logits_p is not None else 0)
+            if not req.generated:
+                req.t_first_token = now
+                req.first_token_step = self.step_idx
+            req.generated.append(tok)
+            if req.pending_preempt_step is not None:
+                req.preempt_recover_steps.append(
+                    self.step_idx - req.pending_preempt_step)
+                req.pending_preempt_step = None
+            req.prefill_done_step = self.step_idx
+            self.slots_p[i] = None
+            self.tables_p[i] = TRASH_PAGE
+            if self._stop_after(req):
+                # Finished at first token: nothing to migrate.
+                self.pool_p.free(s.pages, 0)
+                self._finish(req, now)
+                done.append(req)
+            else:
+                self.mq.append({"req": req, "pages": s.pages,
+                                "prefill_len": s.prefill_len,
+                                "done_step": self.step_idx})
+        # Decode bank: one generated token per busy slot.
+        for i, s in enumerate(self.slots_d):
+            if s is None or not int(act_d[i]):
+                continue
+            req = s.req
+            s.pos += 1
+            tok = (int(np.argmax(logits_d[i, 0]))
+                   if logits_d is not None else 0)
+            req.generated.append(tok)
+            if req.pending_preempt_step is not None:
+                req.preempt_recover_steps.append(
+                    self.step_idx - req.pending_preempt_step)
+                req.pending_preempt_step = None
+            if self._stop_after(req):
+                self.pool_d.free(s.pages, self._shard_of_d(i))
+                self.tables_d[i] = TRASH_PAGE
+                self.slots_d[i] = None
+                self._finish(req, now)
+                done.append(req)
+        migrations = self._drain_migrations(now)
+        self.events.append({
+            "step": self.step_idx,
+            "p_pos": pos_p, "p_n": act_p,
+            "p_tables": self.tables_p.copy(),
+            "d_pos": pos_d, "d_n": act_d,
+            "d_tables": self.tables_d.copy(),
+            "migrations": migrations,
+        })
+        self.step_idx += 1
+        return done
+
+    def run(self, trace: List[Request]) -> List[Request]:
+        """Drive a step-indexed trace to completion; → finished
+        requests in finish order (shed requests land in ``.shed``)."""
+        pending = deque(sorted(trace, key=lambda r: (r.arrival_step,
+                                                     r.rid)))
+        while pending or not self.idle():
+            while pending and pending[0].arrival_step <= self.step_idx:
+                self.submit(pending.popleft())
+            self.step()
+        return self.finished
+
+
+def simulate_disagg_schedule(trace: List[Request], *, slots: int,
+                             prefill_slots: int, page_len: int,
+                             num_pages: int, prefill_pages: int,
+                             max_blocks: int, chunk: int,
+                             n_decode_shards: int,
+                             queue_depth: int = 0,
+                             deadline_steps: int = 0,
+                             stop: str = "length", stop_seed: int = 0,
+                             eos_prob: float = 0.0,
+                             pool_clamp: Optional[int] = None,
+                             cfg=None) -> Dict:
+    """Run the disagg scheduler WITHOUT a device: → the exact
+    two-sided event trace the engine would execute — per-step inputs
+    for both submeshes, every migration event (rid / blocks /
+    destination shard / wait), preemptions, sheds. Valid for the
+    same reason :func:`tpu_p2p.serve.batcher.simulate_schedule` is:
+    scheduling is length-driven, so 0-valued placeholder tokens
+    change no slot transition, page movement, migration, preemption,
+    or seeded stop decision.
+    """
+    trace = [r.fresh() for r in trace]
+    b = DisaggBatcher(
+        None, None, None, cfg, None, None,
+        slots=slots, prefill_slots=prefill_slots, page_len=page_len,
+        num_pages=num_pages, prefill_pages=prefill_pages,
+        max_blocks=max_blocks, chunk=chunk, dry=True,
+        n_decode_shards=n_decode_shards, queue_depth=queue_depth,
+        deadline_steps=deadline_steps, stop=stop, stop_seed=stop_seed,
+        eos_prob=eos_prob, pool_clamp=pool_clamp)
+    finished = b.run(trace)
+    return {
+        "steps": b.step_idx,
+        "busy_steps": len(b.events),
+        "idle_steps": b.idle_steps,
+        "events": b.events,
+        "requests": finished,
+        "shed": b.shed,
+        "preempt_events": b.preempt_events,
+        "migrate_events": b.migrate_events,
+        "migrations": len(b.migrate_events),
+        # Byte accounting needs the model geometry: without ``cfg``
+        # the count is explicitly None, never a silent 0 (the
+        # byte-exact dry == real pin compares it only when cfg is
+        # passed).
+        "kv_migrate_bytes": (b.kv_migrate_bytes
+                             if cfg is not None else None),
+    }
+
+
+def run_disagg_engine(prefill_mesh, decode_mesh, mig_mesh, cfg,
+                      params_prefill, params_decode,
+                      trace: List[Request], *, sc, emit=None,
+                      ledger=None,
+                      clock=time.monotonic) -> dict:
+    """Serve ``trace`` to completion on the disaggregated submeshes;
+    → the colocated engine's summary schema plus the migration
+    half: ``kv_migrated`` / ``kv_migrate_blocks`` /
+    ``kv_migrate_bytes`` / ``serve_kv_migrate_gbps`` (shipped bits
+    over migration wall) / ``migrate_wait_steps_{p50,max}``. The
+    mixed steps AND the migration ships trace under ``ledger``
+    recording, so ``kind="kv_migrate"`` rows land next to the tp
+    psum joins in the same ``{"obs": "serve_ledger"}`` receipt.
+    """
+    from tpu_p2p.serve import resilience as R
+    from tpu_p2p.serve.engine import _r3, _request_record
+
+    trace = [r.fresh() for r in trace]
+    trace, pool_clamp, step_hook = R.apply_serve_faults(trace, sc)
+    batcher = DisaggBatcher(
+        prefill_mesh, decode_mesh, mig_mesh, cfg, params_prefill,
+        params_decode, slots=sc.slots,
+        prefill_slots=sc.prefill_slots, page_len=sc.page_len,
+        num_pages=sc.num_pages, prefill_pages=sc.prefill_pages,
+        max_blocks=sc.max_blocks, chunk=sc.chunk,
+        queue_depth=sc.queue_depth, deadline_steps=sc.deadline_steps,
+        stop=sc.stop, stop_seed=sc.seed, eos_prob=sc.eos_prob,
+        pool_clamp=pool_clamp, step_hook=step_hook,
+        transport=sc.transport, migrate_chunks=sc.migrate_chunks,
+        clock=clock)
+    t0 = clock()
+    if ledger is not None:
+        from tpu_p2p.obs.ledger import recording
+
+        with recording(ledger):
+            finished = batcher.run(trace)
+    else:
+        finished = batcher.run(trace)
+    wall = max(clock() - t0, 1e-9)
+    prompt_toks = sum(r.n_prompt for r in finished)
+    gen_toks = sum(len(r.generated) for r in finished)
+    ttft = [(r.t_first_token - r.t_enqueue) * 1e3 for r in finished
+            if r.t_first_token is not None]
+    tok_ms = [(r.t_finish - r.t_first_token) * 1e3
+              / (len(r.generated) - 1)
+              for r in finished
+              if len(r.generated) > 1 and r.t_finish is not None]
+    shed = batcher.shed
+    waits = [r.migrate_wait_steps for r in finished
+             if r.migrate_wait_steps is not None]
+    mig_gbps = (batcher.kv_migrate_bytes * 8
+                / batcher.migrate_wall_s / 1e9
+                if batcher.migrate_wall_s > 0 else None)
+    summary = {
+        "mode": "disagg",
+        "requests": len(finished),
+        "steps": batcher.step_idx,
+        "idle_steps": batcher.idle_steps,
+        "prompt_tokens": prompt_toks,
+        "gen_tokens": gen_toks,
+        "wall_s": round(wall, 6),
+        "serve_tokens_per_s": round((prompt_toks + gen_toks) / wall,
+                                    3),
+        "gen_tokens_per_s": round(gen_toks / wall, 3),
+        "serve_ttft_ms_p50": _r3(percentile(ttft, 0.50)),
+        "serve_ttft_ms_p99": _r3(percentile(ttft, 0.99)),
+        "serve_tok_ms_p50": _r3(percentile(tok_ms, 0.50)),
+        "serve_tok_ms_p99": _r3(percentile(tok_ms, 0.99)),
+        "shed": len(shed),
+        "shed_frac": round(len(shed) / max(len(trace), 1), 4),
+        "preemptions": len(batcher.preempt_events),
+        "preempt_recover_steps": R.preempt_recover_steps(finished),
+        "kv_migrated": len(batcher.migrate_events),
+        "kv_migrate_blocks": sum(e["blocks"]
+                                 for e in batcher.migrate_events),
+        "kv_migrate_bytes": batcher.kv_migrate_bytes,
+        "serve_kv_migrate_gbps": (round(mig_gbps, 6)
+                                  if mig_gbps is not None else None),
+        "migrate_wait_steps_p50": percentile(waits, 0.50),
+        "migrate_wait_steps_max": (max(waits) if waits else None),
+    }
+    if emit is not None:
+        for r in finished:
+            emit(_request_record(r))
+        for r in shed:
+            emit(_request_record(r))
+        emit({"obs": "serve_summary", **summary})
+        if ledger is not None:
+            from tpu_p2p.obs.ledger import totals_record
+
+            emit(totals_record(ledger))
+    return {**summary, "finished": finished, "shed_requests": shed,
+            "events": batcher.events,
+            "migrate_events": batcher.migrate_events}

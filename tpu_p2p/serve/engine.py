@@ -91,7 +91,7 @@ def _request_record(r: Request) -> dict:
         return (round((b - a) * 1e3, 3)
                 if a is not None and b is not None else None)
 
-    return {
+    rec = {
         "obs": "request",
         "id": r.rid,
         "prompt_tokens": r.n_prompt,
@@ -114,7 +114,26 @@ def _request_record(r: Request) -> dict:
         "shed_step": r.shed_step,
         "deadline_step": r.deadline_step,
         "preemptions": r.preemptions,
+        # Pool identity (round 18, docs/serving_disagg.md): which
+        # page pool holds/held the request's KV — "kv" colocated,
+        # "prefill"/"decode" under disaggregation, so two coexisting
+        # pools stay debuggable from the stream alone.
+        "pool": r.pool,
     }
+    if r.migrate_step is not None or r.migrations:
+        # Migration lifecycle fields ride ONLY on disagg-touched
+        # requests (colocated records keep their round-15 schema plus
+        # the pool tag); migrate_wait_steps is what `obs watch
+        # --max-migrate-wait-steps` alerts on.
+        rec.update({
+            "prefill_done_step": r.prefill_done_step,
+            "migrate_step": r.migrate_step,
+            "migrate_wait_steps": r.migrate_wait_steps,
+            "decode_shard": r.decode_shard,
+            "migrations": r.migrations,
+            "migrated_blocks": r.migrated_blocks,
+        })
+    return rec
 
 
 def run_engine(mesh, cfg, params, trace: List[Request], *,
@@ -208,19 +227,26 @@ def _r3(v):
     return round(v, 3) if v is not None else None
 
 
-def _engine_model(sc: ServeConfig):
+def _engine_model(sc: ServeConfig, prefill_tp: int = 1):
     """The CLI's serving model: a small dense-FFN LM (RoPE + RMSNorm,
     GQA 2:1) — big enough that the mixed step exercises every layer,
     small enough that the 8-device CPU golden run stays fast. MoE
     serving is covered by the parity tests (no-drop capacity); the
     CLI keeps the FFN dense so slot-masked garbage tokens cannot
-    perturb routing capacity (docs/serving.md)."""
+    perturb routing capacity (docs/serving.md).
+
+    ``prefill_tp`` (the disagg prefill submesh's tp size,
+    docs/serving_disagg.md) widens the head counts just enough that
+    KV heads divide the tp axis — the GQA 2:1 ratio holds, and
+    ``prefill_tp <= 2`` keeps the colocated model byte-identical."""
     from tpu_p2p.models import flagship as F
 
+    kv = 2 if prefill_tp <= 2 else int(prefill_tp)
     return F.FlagshipConfig(
-        batch=sc.slots, seq=16, heads=4, kv_heads=2, head_dim=16,
-        stages=2, microbatches=1, dense_ffn=True, moe_mult=2,
-        vocab=sc.vocab, norm=True, rope=True, dtype=sc.dtype,
+        batch=sc.slots, seq=16, heads=2 * kv, kv_heads=kv,
+        head_dim=16, stages=2, microbatches=1, dense_ffn=True,
+        moe_mult=2, vocab=sc.vocab, norm=True, rope=True,
+        dtype=sc.dtype,
     )
 
 
@@ -271,6 +297,28 @@ def _build_parser() -> argparse.ArgumentParser:
                         "either way)")
     p.add_argument("--eos-prob", type=float, default=0.1,
                    help="--stop eos: per-token stop probability")
+    from tpu_p2p.config import TRANSPORTS
+
+    p.add_argument("--disagg", action="store_true",
+                   help="disaggregated prefill/decode: partition the "
+                        "devices into a tp-heavy prefill submesh and "
+                        "dp decode replicas, migrating each request's "
+                        "KV pages across as instrumented p2p "
+                        "transfers (docs/serving_disagg.md); also "
+                        "runs the colocated continuous twin and "
+                        "checks token-stream parity")
+    p.add_argument("--prefill-tp", type=int, default=0,
+                   help="--disagg: prefill submesh tp size == its "
+                        "device count (0 = half the devices)")
+    p.add_argument("--prefill-slots", type=int, default=4,
+                   help="--disagg: prefill-side slot batch")
+    p.add_argument("--migrate-chunks", type=int, default=1,
+                   help="--disagg: split each KV-migration ship into "
+                        "this many chunk hops (the ppermute wave)")
+    p.add_argument("--transport", default="xla", choices=TRANSPORTS,
+                   help="--disagg: migration ship transport (xla = "
+                        "CollectivePermute; pallas_dma = raw async "
+                        "remote copies behind the capability probe)")
     p.add_argument("--obs-jsonl", default=None, metavar="PATH",
                    help="append per-request span records + the serve "
                         "summary to this JSONL timeline")
@@ -313,12 +361,33 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         gen_rng = parse_range(args.gen_len)
         max_len = prompt_rng[1] + gen_rng[1]
         max_blocks = -(-max_len // args.page_len)
+        prefill_tp = 0
+        n_dec = n
+        if args.disagg:
+            if args.batching != "both":
+                # The disagg engine is continuous by construction and
+                # runs its own A/B (vs the colocated twin) — honor
+                # the repo's loud-reject convention for incompatible
+                # knob combos instead of silently dropping one.
+                raise SystemExit(
+                    "--disagg runs continuous batching against the "
+                    "colocated twin; drop --batching"
+                )
+            from tpu_p2p.serve.disagg import build_disagg_meshes
+
+            # Validate the partition up front (build_mesh-style) so a
+            # bad --prefill-tp fails before any compile.
+            pre_mesh, dec_mesh, mig_mesh = build_disagg_meshes(
+                args.prefill_tp)
+            prefill_tp = int(pre_mesh.shape["tp"])
+            n_dec = int(dec_mesh.shape["dp"])
         pages = args.pages
         if pages is None:
             # Worst case every slot serves a max-length request, plus
             # each shard's trash page.
-            pages = (args.slots * max_blocks + n)
-            pages += (-pages) % n
+            shards = n_dec if args.disagg else n
+            pages = (args.slots * max_blocks + shards)
+            pages += (-pages) % shards
         sc = ServeConfig(
             slots=args.slots, page_len=args.page_len, num_pages=pages,
             max_blocks=max_blocks, chunk=args.chunk,
@@ -327,17 +396,40 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             gen_len=gen_rng, vocab=args.vocab, dtype=args.dtype,
             queue_depth=args.queue_depth,
             deadline_steps=args.deadline_steps, stop=args.stop,
-            eos_prob=args.eos_prob,
+            eos_prob=args.eos_prob, disagg=args.disagg,
+            prefill_tp=prefill_tp,
+            prefill_slots=args.prefill_slots,
+            # Prefill pool holds active prefills PLUS migration-queue
+            # residents waiting on decode capacity.
+            prefill_pages=((args.prefill_slots + args.slots)
+                           * max_blocks + 1) if args.disagg else 0,
+            migrate_chunks=args.migrate_chunks,
+            transport=args.transport,
         )
-        cfg = _engine_model(sc)
-        params = F.place_flagship_params(F.init_flagship_params(cfg),
-                                         mesh)
+        cfg = _engine_model(sc, prefill_tp=max(prefill_tp, 1))
+        params_seeded = F.init_flagship_params(cfg)
+        params = F.place_flagship_params(params_seeded, mesh)
         trace = synthetic_trace(sc)
-        axes = dict(zip(mesh.axis_names, mesh.devices.shape))
-        print(f"serve mesh {axes}: slots={sc.slots} "
-              f"page_len={sc.page_len} pages={sc.num_pages} "
-              f"window={sc.max_blocks * sc.page_len} chunk={sc.chunk} "
-              f"vocab={sc.vocab} {sc.dtype}")
+        if sc.disagg:
+            pre_axes = dict(zip(pre_mesh.axis_names,
+                                pre_mesh.devices.shape))
+            dec_axes = dict(zip(dec_mesh.axis_names,
+                                dec_mesh.devices.shape))
+            print(f"serve mesh disagg prefill {pre_axes} + decode "
+                  f"{dec_axes}: slots={sc.slots}"
+                  f"(+{sc.prefill_slots} prefill) "
+                  f"page_len={sc.page_len} "
+                  f"pages={sc.num_pages}+{sc.prefill_pages} "
+                  f"window={sc.max_blocks * sc.page_len} "
+                  f"chunk={sc.chunk} transport={sc.transport} "
+                  f"vocab={sc.vocab} {sc.dtype}")
+        else:
+            axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            print(f"serve mesh {axes}: slots={sc.slots} "
+                  f"page_len={sc.page_len} pages={sc.num_pages} "
+                  f"window={sc.max_blocks * sc.page_len} "
+                  f"chunk={sc.chunk} "
+                  f"vocab={sc.vocab} {sc.dtype}")
         print(f"trace: {sc.requests} requests seed={sc.seed} "
               f"rate={sc.rate}/step prompt {prompt_rng[0]}-"
               f"{prompt_rng[1]} gen {gen_rng[0]}-{gen_rng[1]}")
@@ -351,6 +443,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             def emit(rec, fh=fh):
                 fh.write(_json.dumps(rec) + "\n")
                 fh.flush()
+        if sc.disagg:
+            try:
+                return _disagg_cli(pre_mesh, dec_mesh, mig_mesh, mesh,
+                                   cfg, params_seeded, params, trace,
+                                   sc, emit)
+            finally:
+                if fh is not None:
+                    fh.close()
         modes = (("continuous", "static") if args.batching == "both"
                  else (args.batching,))
         ledger = None
@@ -408,6 +508,71 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         raise
     except BaseException as e:  # noqa: BLE001 — single fail-fast (L8)
         return fail_fast(e)
+
+
+def _disagg_cli(pre_mesh, dec_mesh, mig_mesh, mesh, cfg,
+                params_seeded, params_colocated, trace, sc,
+                emit) -> int:
+    """The ``serve --disagg`` run: the disaggregated engine on the
+    partitioned meshes, then the colocated continuous twin on the
+    full mesh for the A/B and the BITWISE token-stream parity check
+    (the acceptance pin the golden carries end to end)."""
+    import dataclasses
+
+    from tpu_p2p.models import flagship as F
+    from tpu_p2p.serve.disagg import run_disagg_engine
+
+    ledger = None
+    if emit is not None:
+        from tpu_p2p.obs.ledger import CollectiveLedger
+
+        ledger = CollectiveLedger()
+    params_pre = F.place_flagship_params(params_seeded, pre_mesh)
+    params_dec = F.place_flagship_params(params_seeded, dec_mesh)
+    s = run_disagg_engine(pre_mesh, dec_mesh, mig_mesh, cfg,
+                          params_pre, params_dec, trace, sc=sc,
+                          emit=emit, ledger=ledger)
+    print(f"disagg: {s['requests']} requests, "
+          f"{s['prompt_tokens']} prompt + "
+          f"{s['gen_tokens']} generated tokens in "
+          f"{s['steps']} steps ({s['idle_steps']} idle)")
+    print(f"  {s['serve_tokens_per_s']:,.0f} tokens/s  "
+          f"ttft p50 {_f(s['serve_ttft_ms_p50'])}ms "
+          f"p99 {_f(s['serve_ttft_ms_p99'])}ms  "
+          f"tok p50 {_f(s['serve_tok_ms_p50'])}ms "
+          f"p99 {_f(s['serve_tok_ms_p99'])}ms")
+    mib = s["kv_migrate_bytes"] / 2**20
+    gbps = s["serve_kv_migrate_gbps"]
+    print(f"  kv_migrate: {s['kv_migrated']} migrations, "
+          f"{s['kv_migrate_blocks']} pages ({mib:.2f} MiB, "
+          f"{_f(gbps)} Gbps)  wait p50 "
+          f"{int(s['migrate_wait_steps_p50'] or 0)} max "
+          f"{int(s['migrate_wait_steps_max'] or 0)} steps")
+    if s["shed"] or s["preemptions"]:
+        print(f"  shed={s['shed']} (frac {s['shed_frac']:.2f})  "
+              f"preemptions={s['preemptions']} recover_steps="
+              f"{s['preempt_recover_steps']}")
+    # The colocated continuous twin on the SAME trace and params —
+    # the A/B plus the bitwise token-stream acceptance check. The
+    # twin runs with the colocated pool geometry (one pool over the
+    # full mesh's shards).
+    n = int(np.prod(mesh.devices.shape))
+    pages = sc.slots * sc.max_blocks + n
+    pages += (-pages) % n
+    sc_co = dataclasses.replace(sc, disagg=False, num_pages=pages,
+                                prefill_pages=0)
+    co = run_engine(mesh, cfg, params_colocated, trace, sc=sc_co,
+                    mode="continuous")
+    want = {r.rid: list(r.generated) for r in co["finished"]}
+    got = {r.rid: list(r.generated) for r in s["finished"]}
+    matched = sum(1 for rid, toks in got.items()
+                  if want.get(rid) == toks)
+    parity = "OK" if (matched == len(got) == len(want)
+                      and len(got) > 0) else "FAIL"
+    print(f"colocated twin: {co['requests']} requests in "
+          f"{co['steps']} steps ({co['idle_steps']} idle)  "
+          f"token parity {parity} ({matched}/{len(got)} bitwise)")
+    return 0 if parity == "OK" else 1
 
 
 def _f(v):

@@ -80,10 +80,18 @@ class PagePool:
     page is never handed out, freeing a page not currently allocated
     (or double-freeing) raises, and after every request of a trace
     finishes the pool is exactly full again (no leak).
+
+    ``name`` tags the pool's IDENTITY (round-18 satellite,
+    docs/serving_disagg.md): the disaggregated engine runs a
+    prefill-side pool and a decode-side pool side by side, and an
+    exhaustion or free-list violation message that does not say WHICH
+    pool ran dry is undebuggable — every invariant message and the
+    engine's ``{"obs": "request"}`` records carry the tag. The
+    colocated engine's single pool keeps the default ``"kv"``.
     """
 
     def __init__(self, num_pages: int, page_len: int,
-                 n_shards: int = 1) -> None:
+                 n_shards: int = 1, name: str = "kv") -> None:
         if page_len <= 0 or page_len % 8:
             raise ValueError(
                 f"page_len must be a positive multiple of 8 (the band "
@@ -100,6 +108,7 @@ class PagePool:
                 f"need >= 2 pages per shard (trash + 1 usable), got "
                 f"{per_shard}"
             )
+        self.name = str(name)
         self.page_len = page_len
         self.n_shards = n_shards
         self.pages_per_shard = per_shard
@@ -129,13 +138,13 @@ class PagePool:
         """
         if usable < 1:
             raise ValueError(
-                f"clamp must leave >= 1 usable page per shard, got "
-                f"{usable}"
+                f"pool {self.name!r}: clamp must leave >= 1 usable "
+                f"page per shard, got {usable}"
             )
         if any(self._allocated):
             raise RuntimeError(
-                "clamp_capacity applies at construction, before any "
-                "page is handed out"
+                f"pool {self.name!r}: clamp_capacity applies at "
+                "construction, before any page is handed out"
             )
         usable = min(usable, self.pages_per_shard - 1)
         for shard in range(self.n_shards):
@@ -149,7 +158,8 @@ class PagePool:
         """→ one shard-local page index; raises :class:`OutOfPages`."""
         if not self._free[shard]:
             raise OutOfPages(
-                f"shard {shard}: all {self.capacity} pages in use"
+                f"pool {self.name!r} shard {shard}: all "
+                f"{self.capacity} pages in use"
             )
         pid = self._free[shard].pop()
         self._allocated[shard].add(pid)
@@ -159,7 +169,7 @@ class PagePool:
         """Allocate ``n`` pages atomically (all or nothing)."""
         if self.available(shard) < n:
             raise OutOfPages(
-                f"shard {shard}: need {n} pages, "
+                f"pool {self.name!r} shard {shard}: need {n} pages, "
                 f"{self.available(shard)} free"
             )
         return [self.alloc(shard) for _ in range(n)]
@@ -181,9 +191,10 @@ class PagePool:
         for pid in pages:
             if pid not in self._allocated[shard] or pid in seen:
                 raise ValueError(
-                    f"shard {shard}: page {pid} is not allocated "
-                    "(double free, trash page, out of range, or "
-                    "repeated in this call) — nothing was freed"
+                    f"pool {self.name!r} shard {shard}: page {pid} "
+                    "is not allocated (double free, trash page, out "
+                    "of range, or repeated in this call) — nothing "
+                    "was freed"
                 )
             seen.add(pid)
         for pid in pages:
