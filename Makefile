@@ -17,7 +17,7 @@ SHELL    := /bin/bash
 
 NATIVE_SO := native/libtpu_p2p_native.so
 
-.PHONY: all native run test tier1 bench obs health serve serve-disagg serve-chaos ckpt-chaos clean
+.PHONY: all native run test tier1 bench obs topo health serve serve-disagg serve-chaos ckpt-chaos clean
 
 all: native
 
@@ -51,6 +51,16 @@ bench: native
 # gate — nonzero exit on regression, so CI can gate on it.
 obs:
 	$(PYTHON) -m tpu_p2p obs $(ARGS)
+
+# Topology-engine smoke (docs/topology.md): a deterministic FaultPlan
+# link throttle, the host-timed probe seeing it, and the placement
+# optimizers (ring order + KV-migration placement) routing around it
+# while bitwise parity pins that re-placement never changes computed
+# values — nonzero exit unless both optimizers beat the naive
+# placement's predicted cost. Defaults to the simulated 8-device CPU
+# mesh so it runs anywhere; override with ARGS= on real hardware.
+topo:
+	$(PYTHON) -m tpu_p2p topo --smoke $(if $(ARGS),$(ARGS),--cpu-mesh 8)
 
 # Injected-fault health smoke (docs/health.md): degraded link,
 # straggler rank, and lost host + self-healing resume, each detected

@@ -1083,7 +1083,9 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         # p2p_lat_us_xla (the XLA baseline arm; latency_8b_p50_us
         # grades the same dispatch-floor family) for the checkpoint-
         # durability pair (test_round17_budget_trade pins the move).
-        "pp_bubble_frac_zb": 0.1905,
+        # pp_bubble_frac_zb (the remaining analytic schedule
+        # constant) left in the round-19 trade for the topology pair
+        # (test_round19_budget_trade).
         "pp_step_ms_sched_zb": 98.765,
         "obs_step_ms_p50": 123.456,
         # Round 12: the health pair joined the line; "devices" (the
@@ -1098,9 +1100,11 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         # four *_step_ms_overlap_none baselines moved to
         # BENCH_detail.json (never gated — only the overlap variants
         # are — never drift-quoted; the min/max_gbps precedent).
-        # p2p_lat_us_xla left in the round-17 trade (note above).
+        # p2p_lat_us_xla left in the round-17 trade (note above);
+        # ring_gbps_xla left in the round-19 trade for the topology
+        # pair (the same baseline-arm rule; the pallas arm stays as
+        # the dma sentinel — test_round19_budget_trade).
         "p2p_lat_us_pallas": 98.7654,
-        "ring_gbps_xla": 1234.56,
         "ring_gbps_pallas": 1187.43,
         # Round 13: the serve quartet joined the line;
         # flagship_large_tokens_per_s (byte-derivable from the step
@@ -1116,8 +1120,9 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         "serve_tokens_per_s": 533333,
         "serve_tok_ms_p99": 123.456,
         # Round 15: the serve-resilience chaos pair (bench.py
-        # _serve_resilience_metrics).
-        "serve_preempt_recover_steps": 12,
+        # _serve_resilience_metrics); serve_preempt_recover_steps
+        # left in the round-19 trade — `make serve-chaos`'s own exit
+        # criterion gates recovery harder (test_round19_budget_trade).
         "serve_shed_frac_overload": 0.4861,
         # Round 17: the checkpoint-durability pair (bench.py
         # _ckpt_metrics).
@@ -1127,6 +1132,11 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         # _serve_disagg_metrics; publishes on >= 2-device rounds).
         "serve_disagg_tokens_per_s": 533333,
         "serve_kv_migrate_gbps": 1234.56,
+        # Round 19: the topology-engine pair (bench.py _topo_metrics;
+        # publishes on >= 3-device rounds — a smaller mesh's
+        # placement is degenerate and TOPO_NULL names it).
+        "topo_route_gain": 12.3456,
+        "topo_migrate_gbps_gain": 3.4567,
     }
     # Every headline key must have a realistic value in this test —
     # a key added to HEADLINE_KEYS without extending this table would
@@ -1251,16 +1261,15 @@ def test_dma_transport_metrics_probe_failure_null_schema(monkeypatch):
 def test_dma_headline_keys_survive_compact_budget():
     # Satellite contract (round 11): the transport head-to-head keys
     # ride the ≤1 KiB compact line at realistic widths.
-    # (p2p_lat_us_xla left the line in the round-17 budget trade —
-    # test_round17_budget_trade pins that move.)
-    new = ("p2p_lat_us_pallas",
-           "ring_gbps_xla", "ring_gbps_pallas")
+    # (p2p_lat_us_xla left the line in the round-17 budget trade,
+    # ring_gbps_xla in the round-19 one — test_round17/19_budget_
+    # trade pin those moves; the pallas arms stay as the sentinels.)
+    new = ("p2p_lat_us_pallas", "ring_gbps_pallas")
     for k in new:
         assert k in bench.HEADLINE_KEYS, k
     detail = {
         "devices": 256,
         "p2p_lat_us_pallas": 98.7654,
-        "ring_gbps_xla": 1234.56,
         "ring_gbps_pallas": 1187.43,
     }
     result = {
@@ -1340,8 +1349,10 @@ def test_round14_budget_trade():
     # pp_bubble_frac_1f1b joined the line in round 14 and left it
     # again in the round-15 trade (test_round15_budget_trade);
     # pp_step_ms_sched_1f1b followed in round 17
-    # (test_round17_budget_trade).
-    for k in ("pp_bubble_frac_zb", "pp_step_ms_sched_zb"):
+    # (test_round17_budget_trade), and pp_bubble_frac_zb in round 19
+    # (test_round19_budget_trade) — the measured zb arm is what
+    # remains graded of the quartet.
+    for k in ("pp_step_ms_sched_zb",):
         assert k in bench.HEADLINE_KEYS, k
         assert k in bench.SCHED_NULL, k
         assert k in TOLERANCES, k
@@ -1366,8 +1377,10 @@ def test_round15_budget_trade():
         assert k not in TOLERANCES, k
     assert "ring_achieved_gbps" in bench.OBS_NULL
     assert "pp_bubble_frac_1f1b" in bench.SCHED_NULL
-    for k in ("serve_preempt_recover_steps",
-              "serve_shed_frac_overload"):
+    # (serve_preempt_recover_steps itself left the line in the
+    # round-19 trade — test_round19_budget_trade pins that move; the
+    # shed fraction remains the graded resilience key.)
+    for k in ("serve_shed_frac_overload",):
         assert k in bench.HEADLINE_KEYS, k
         assert k in bench.RESIL_NULL, k
         assert k in TOLERANCES, k
@@ -1425,6 +1438,79 @@ def test_round18_budget_trade():
         assert k in bench.HEADLINE_KEYS, k
         assert k in bench.DISAGG_NULL, k
         assert k in TOLERANCES, k
+
+
+def test_round19_budget_trade():
+    # The round-19 budget trade, pinned like the round-13..18 ones:
+    # three keys left the compact line for the topology-engine pair
+    # but still measure into BENCH_detail.json. pp_bubble_frac_zb is
+    # an analytic CONSTANT of the zb schedule at the fixed canonical
+    # shape (the pp_bubble_frac_1f1b precedent from round 15 — the
+    # zb < 1f1b claim stays enforced inside _pp_sched_metrics and the
+    # MEASURED pp_step_ms_sched_zb stays graded); ring_gbps_xla is
+    # the XLA baseline arm of the transport head-to-head (the
+    # p2p_lat_us_xla precedent from round 17 — the pallas arm stays
+    # as the dma sentinel, and the per-link XLA truth persists in the
+    # MULTICHIP_r*.json matrices the topology engine consumes);
+    # serve_preempt_recover_steps is a schedule-deterministic integer
+    # whose real gate is `make serve-chaos`'s own exit criterion (the
+    # heal_resume_loss_delta precedent from round 18 — the shed
+    # fraction stays as the graded resilience key). Tolerances
+    # retired WITH them per the gate's tolerance-⊆-headline rule.
+    from tpu_p2p.obs.regress import TOLERANCES
+
+    gone = ("pp_bubble_frac_zb", "ring_gbps_xla",
+            "serve_preempt_recover_steps")
+    for k in gone:
+        assert k not in bench.HEADLINE_KEYS, k
+        assert k not in TOLERANCES, k
+    assert "pp_bubble_frac_zb" in bench.SCHED_NULL
+    assert "ring_gbps_xla" in bench.DMA_NULL
+    assert "serve_preempt_recover_steps" in bench.RESIL_NULL
+    for k in ("topo_route_gain", "topo_migrate_gbps_gain"):
+        assert k in bench.HEADLINE_KEYS, k
+        assert k in bench.TOPO_NULL, k
+        assert k in TOLERANCES, k
+
+
+# ------------------------------------------------------- topo metric
+
+
+def test_topo_metrics_null_schema_on_failed_smoke(monkeypatch):
+    # A failing smoke must publish the TOPO_NULL schema with the
+    # reason — a "gain" the smoke's own verdict refutes must never
+    # reach the gate (the disagg-parity precedent).
+    from tpu_p2p.topo import smoke as topo_smoke
+
+    monkeypatch.setattr(
+        topo_smoke, "run_smoke",
+        lambda **kw: {"ok": False, "health_flagged": False,
+                      "ring": {"avoided": False},
+                      "migrate": {"topo_on_degraded": 3},
+                      "parity": {"ring": True},
+                      "topo_route_gain": 99.0,
+                      "topo_migrate_gbps_gain": 99.0})
+    out = bench._topo_metrics(None)
+    assert set(out) == set(bench.TOPO_NULL)
+    assert out["topo_route_gain"] is None
+    assert out["topo_migrate_gbps_gain"] is None
+    assert out["topo_ok"] is False
+    assert "incomplete" in out["topo_error"]
+
+
+def test_topo_metrics_publishes_gains_on_ok(monkeypatch):
+    from tpu_p2p.topo import smoke as topo_smoke
+
+    monkeypatch.setattr(
+        topo_smoke, "run_smoke",
+        lambda **kw: {"ok": True, "topo_route_gain": 11.51,
+                      "topo_migrate_gbps_gain": 2.95})
+    out = bench._topo_metrics(None)
+    assert out["topo_route_gain"] == 11.51
+    assert out["topo_migrate_gbps_gain"] == 2.95
+    assert out["topo_ok"] is True
+    assert out["topo_error"] is None
+    assert out["topo_devices"] == 8
 
 
 # ------------------------------------------------ serve disagg metric
@@ -1634,16 +1720,17 @@ def test_serve_headline_keys_survive_compact_budget():
 
 
 def test_serve_resilience_headline_keys_survive_compact_budget():
-    # Satellite contract (round 15): the chaos pair rides the ≤1 KiB
-    # compact line at realistic widths (the general full-schema pin
-    # covers the fully-populated line; this asserts the pair
-    # specifically survives).
-    new = ("serve_preempt_recover_steps", "serve_shed_frac_overload")
+    # Satellite contract (round 15): the graded chaos key rides the
+    # ≤1 KiB compact line at realistic widths (the general
+    # full-schema pin covers the fully-populated line; this asserts
+    # the key specifically survives). serve_preempt_recover_steps
+    # left the line in the round-19 trade (test_round19_budget_trade
+    # pins that move).
+    new = ("serve_shed_frac_overload",)
     for k in new:
         assert k in bench.HEADLINE_KEYS, k
     detail = {
         "devices": 256,
-        "serve_preempt_recover_steps": 12,
         "serve_shed_frac_overload": 0.4861,
     }
     result = {

@@ -36,8 +36,13 @@ _RAW_CALL = re.compile(
 # Round 13 added serve/ — the paged decode step issues the same tp
 # psum joins and ep all_to_alls as the dense one, and a raw collective
 # there would leak serving transport past the ledger exactly like the
-# round-9 moe.py hole.
-SCANNED = ("models", "ops", "serve")
+# round-9 moe.py hole. Round 19 added topo/ — the topology engine's
+# smoke builds ring-reorder parity programs and its model defers to
+# the instrumented health probe; a raw ppermute there would both leak
+# past the ledger AND dodge the fault throttle the whole subsystem is
+# graded against (collectives.ppermute is the throttle's application
+# point), so the probe/parity traffic must ride the wrappers.
+SCANNED = ("models", "ops", "serve", "topo")
 
 
 def _py_files():
@@ -128,7 +133,24 @@ def test_lint_scans_the_expected_trees():
             "the migration ship moved out of serve/disagg.py — "
             "extend SCANNED (and this self-test) to wherever it went"
         )
-    assert len(files) >= 19, files
+    # The round-19 topology tree is SCANNED: the smoke's ring-reorder
+    # parity programs ship real bytes (a raw ppermute there would
+    # leak past the ledger AND dodge the fault throttle it is graded
+    # against), and the parity body must actually live there.
+    assert "smoke.py" in names and "place.py" in names \
+        and "model.py" in names, sorted(names)
+    smoke_src = next(p for p in files
+                     if os.path.basename(p) == "smoke.py"
+                     and os.sep + "topo" + os.sep in p)
+    with open(smoke_src) as fh:
+        smoke_text = fh.read()
+    assert "chunked_ppermute_compute" in smoke_text \
+        and "ring_allgather_matmul" in smoke_text, (
+            "the topo smoke's parity programs moved out of "
+            "topo/smoke.py — extend SCANNED (and this self-test) to "
+            "wherever they went"
+        )
+    assert len(files) >= 23, files
 
 
 # ---------------------------------------------------- pallas transport

@@ -39,10 +39,10 @@ def test_headline_from_compact_line_era():
     head = R.headline_from_artifact({
         "parsed": {"metric": "m", "value": 1.0,
                    "headline": {"flagship_large_step_ms": 360.33,
-                                "ring_gbps_xla": 123.4}},
+                                "ring_gbps_pallas": 123.4}},
     })
     assert head == {"flagship_large_step_ms": 360.33,
-                    "ring_gbps_xla": 123.4}
+                    "ring_gbps_pallas": 123.4}
 
 
 def test_headline_from_parsed_null_recovers_from_tail():
@@ -187,10 +187,11 @@ def test_compare_missing_keys_skip_never_fail():
     rows = _rows_by_key(R.compare({}, [("r1", {})]))
     assert all(r["verdict"] == "SKIP" for r in rows.values())
     # New key with no prior: SKIP (headline keys accrete by design).
-    # (re-keyed to ring_gbps_xla when round 15 retired the
-    # ring_achieved_gbps tolerance with its compact-line slot)
-    rows = _rows_by_key(R.compare({"ring_gbps_xla": 100.0}, []))
-    assert rows["ring_gbps_xla"]["verdict"] == "SKIP"
+    # (re-keyed to ring_gbps_pallas when round 19 retired the
+    # ring_gbps_xla tolerance with its compact-line slot — the same
+    # move that retired ring_achieved_gbps in round 15)
+    rows = _rows_by_key(R.compare({"ring_gbps_pallas": 100.0}, []))
+    assert rows["ring_gbps_pallas"]["verdict"] == "SKIP"
 
 
 def test_print_gate_rc_and_table():

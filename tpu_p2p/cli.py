@@ -324,6 +324,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_p2p.serve.engine import main as serve_main
 
         return serve_main(list(argv[1:]))
+    if argv and argv[0] == "topo":
+        # ``python -m tpu_p2p topo`` — the topology model report +
+        # placement recommendations, and (--smoke) the graded
+        # injected-throttle check (tpu_p2p/topo/, docs/topology.md).
+        # Dispatched like obs/serve: its own flag set and exit-code
+        # contract (nonzero when the smoke fails to route around an
+        # injected degraded link).
+        from tpu_p2p.topo.cli import main as topo_main
+
+        return topo_main(list(argv[1:]))
     if argv and argv[0] == "train":
         # ``python -m tpu_p2p train`` — the training loop
         # (tpu_p2p/train.py: durable checkpoint/resume, --heal,

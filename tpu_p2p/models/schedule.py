@@ -238,7 +238,8 @@ def per_rank_idle(program: TickProgram) -> List[dict]:
     return out
 
 
-def price_program(program: TickProgram, payload_bytes: int) -> dict:
+def price_program(program: TickProgram, payload_bytes: int,
+                  topology=None) -> dict:
     """Analytic transport bill of one program execution, priced with
     the collective ledger's busbw conventions
     (:func:`tpu_p2p.obs.ledger.wire_bytes` — per directed link for the
@@ -250,21 +251,53 @@ def price_program(program: TickProgram, payload_bytes: int) -> dict:
     (:func:`per_rank_idle`) — the bubble decomposed to the device
     whose wall clock it is, which is what the cost-proportional
     switch lowering turns from an accounting fiction into genuinely
-    idle time."""
+    idle time.
+
+    ``topology`` (a :class:`tpu_p2p.topo.model.Topology`, round 19 —
+    docs/topology.md) upgrades the bill from uniform busbw units to
+    PER-LINK pricing: every hop runs its edges concurrently, so each
+    hop's predicted wall time is the payload over its slowest
+    effective link (:meth:`~tpu_p2p.topo.model.Topology.ship_time_s`)
+    — rows gain ``hop_s`` / ``bottleneck_edge`` /
+    ``bottleneck_gbps``, and the totals gain ``hop_s_total`` plus the
+    program-wide ``bottleneck_gbps_min``. The analytic bubble/idle
+    accounting (and every pre-round-19 key) is unchanged when
+    ``topology`` is None — per-link pricing is additive, never a
+    rewrite of the uniform units the gate history is denominated in."""
     rows: List[dict] = []
     total_wire = 0
+    total_hop_s = 0.0
+    min_gbps = None
     for i, tick in enumerate(program.ticks):
         for hop in tick.hops:
             wire = _ledger.wire_bytes("ppermute", program.devices,
                                       payload_bytes)
-            rows.append({
+            row = {
                 "tick": i,
                 "payload": hop.payload,
                 "edges": hop.edges,
                 "wire_bytes": wire,
-            })
+            }
+            if topology is not None and hop.edges:
+                # REPORTING view (penalty off): the bill predicts
+                # what the wire would do, not the avoidance bias the
+                # optimizers steer by (Topology.ship_time_s).
+                hop_s = topology.ship_time_s(payload_bytes, hop.edges,
+                                             effective=False)
+                bneck = topology.bottleneck_edge(hop.edges,
+                                                 effective=False)
+                gbps = topology.link_gbps(*bneck)
+                row.update({
+                    "hop_s": hop_s,
+                    "bottleneck_edge": bneck,
+                    "bottleneck_gbps": gbps,
+                })
+                total_hop_s += hop_s
+                min_gbps = gbps if min_gbps is None \
+                    else min(min_gbps, gbps)
+            rows.append(row)
             total_wire += wire
-    return {
+    bill = {
         "name": program.name,
         "ticks": program.num_ticks,
         "hops": len(rows),
@@ -273,6 +306,11 @@ def price_program(program: TickProgram, payload_bytes: int) -> dict:
         "per_rank": per_rank_idle(program),
         "rows": rows,
     }
+    if topology is not None:
+        bill["hop_s_total"] = total_hop_s
+        bill["bottleneck_gbps_min"] = min_gbps
+        bill["topology_source"] = topology.source
+    return bill
 
 
 # ----------------------------------------------------------- compilers

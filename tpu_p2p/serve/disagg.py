@@ -408,6 +408,7 @@ class DisaggBatcher:
                  pool_clamp: Optional[int] = None,
                  step_hook: Optional[Callable[[int], None]] = None,
                  transport: str = "xla", migrate_chunks: int = 1,
+                 placement: Optional[Callable] = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         from tpu_p2p.config import SERVE_STOPS
 
@@ -444,6 +445,17 @@ class DisaggBatcher:
         self.stop, self.stop_seed = stop, stop_seed
         self.eos_prob = eos_prob
         self.step_hook = step_hook
+        # Migration placement policy (round 19, docs/topology.md):
+        # ``placement(blocks, candidates, block_bytes) -> shard``
+        # over the dry-visible candidate list; None = free-pages-
+        # first, the pre-topology rule (byte-identical scheduling).
+        # Resolved ONCE here — placement sits on the per-step
+        # scheduling path.
+        if placement is None:
+            from tpu_p2p.topo.place import free_pages_first
+
+            placement = free_pages_first
+        self.placement = placement
         self.clock = clock
         # Two pools, two identities (the round-18 satellite): a
         # prefill-side exhaustion message must not read like a
@@ -651,9 +663,15 @@ class DisaggBatcher:
 
     def _choose_decode_shard(self, blocks: int) -> Optional[int]:
         """Deterministic placement off dry-visible state alone: the
-        shard with a free slot AND ``blocks`` free pages, most free
-        pages first, ties to the lowest shard index."""
-        best = None
+        ELIGIBLE shards (a free slot AND ``blocks`` free pages) go to
+        the placement policy — free-pages-first (most free pages,
+        ties to the lowest shard index) when none was injected, the
+        topology-aware predicted-ship-time policy
+        (:func:`tpu_p2p.topo.place.topo_migration_placement`) when
+        one was. Policies see only ``(shard, free_pages)`` pairs plus
+        the migration's wire bytes, so dry == real stays event-exact
+        under ANY policy."""
+        cands = []
         for shard in range(self.n_dec):
             has_slot = any(
                 self.slots_d[i] is None
@@ -664,10 +682,11 @@ class DisaggBatcher:
             free = self.pool_d.available(shard)
             if free < blocks:
                 continue
-            key = (-free, shard)
-            if best is None or key < best[0]:
-                best = (key, shard)
-        return best[1] if best is not None else None
+            cands.append((shard, free))
+        if not cands:
+            return None
+        return int(self.placement(blocks, cands,
+                                  self._block_bytes(blocks)))
 
     def _finish(self, req: Request, now: float) -> None:
         req.t_finish = now
@@ -851,6 +870,7 @@ def simulate_disagg_schedule(trace: List[Request], *, slots: int,
                              stop: str = "length", stop_seed: int = 0,
                              eos_prob: float = 0.0,
                              pool_clamp: Optional[int] = None,
+                             placement: Optional[Callable] = None,
                              cfg=None) -> Dict:
     """Run the disagg scheduler WITHOUT a device: → the exact
     two-sided event trace the engine would execute — per-step inputs
@@ -859,7 +879,10 @@ def simulate_disagg_schedule(trace: List[Request], *, slots: int,
     same reason :func:`tpu_p2p.serve.batcher.simulate_schedule` is:
     scheduling is length-driven, so 0-valued placeholder tokens
     change no slot transition, page movement, migration, preemption,
-    or seeded stop decision.
+    or seeded stop decision. ``placement`` injects a migration
+    placement policy (``None`` = free-pages-first); policies read
+    only dry-visible candidates, so dry == real holds under any
+    (docs/topology.md).
     """
     trace = [r.fresh() for r in trace]
     b = DisaggBatcher(
@@ -869,7 +892,7 @@ def simulate_disagg_schedule(trace: List[Request], *, slots: int,
         max_blocks=max_blocks, chunk=chunk, dry=True,
         n_decode_shards=n_decode_shards, queue_depth=queue_depth,
         deadline_steps=deadline_steps, stop=stop, stop_seed=stop_seed,
-        eos_prob=eos_prob, pool_clamp=pool_clamp)
+        eos_prob=eos_prob, pool_clamp=pool_clamp, placement=placement)
     finished = b.run(trace)
     return {
         "steps": b.step_idx,
@@ -893,7 +916,7 @@ def simulate_disagg_schedule(trace: List[Request], *, slots: int,
 def run_disagg_engine(prefill_mesh, decode_mesh, mig_mesh, cfg,
                       params_prefill, params_decode,
                       trace: List[Request], *, sc, emit=None,
-                      ledger=None,
+                      ledger=None, placement=None,
                       clock=time.monotonic) -> dict:
     """Serve ``trace`` to completion on the disaggregated submeshes;
     → the colocated engine's summary schema plus the migration
@@ -919,7 +942,7 @@ def run_disagg_engine(prefill_mesh, decode_mesh, mig_mesh, cfg,
         stop=sc.stop, stop_seed=sc.seed, eos_prob=sc.eos_prob,
         pool_clamp=pool_clamp, step_hook=step_hook,
         transport=sc.transport, migrate_chunks=sc.migrate_chunks,
-        clock=clock)
+        placement=placement, clock=clock)
     t0 = clock()
     if ledger is not None:
         from tpu_p2p.obs.ledger import recording

@@ -1,0 +1,49 @@
+"""Topology engine: the measured N×N link matrix as a first-class
+placement & routing subsystem (round 19 tentpole, docs/topology.md).
+
+The paper's whole output is a per-link bandwidth matrix, and the repo
+measures it three ways (device-trace join, ``health.probe_link_matrix``,
+``MULTICHIP_r*.json`` history) — this package is what *consumes* it:
+
+- :mod:`tpu_p2p.topo.model` — the :class:`Topology` object: per-link
+  Gbps with per-cell provenance, constructed from the best available
+  source over an explicit ladder (trace > history > probe > preset),
+  unmeasured cells inheriting the fleet median (never 0), degraded
+  links fed by :mod:`tpu_p2p.obs.health` verdicts.
+- :mod:`tpu_p2p.topo.place` — pure host-side optimizers: ring-order
+  selection (maximize the min link on the cycle; the chosen
+  permutation reorders the MESH DEVICES, so step values stay bitwise)
+  and matrix-driven KV-migration placement for
+  :mod:`tpu_p2p.serve.disagg` (predicted ship time replaces
+  free-pages-first, which demotes to tie-break).
+- :mod:`tpu_p2p.topo.smoke` — the graded injected-throttle smoke
+  (``make topo``): a deterministic :class:`~tpu_p2p.obs.faults.
+  FaultPlan` link throttle, the probe seeing it, the optimizers
+  routing around it, and bitwise parity pins that re-placement never
+  changes computed values.
+- :mod:`tpu_p2p.topo.cli` — ``python -m tpu_p2p topo``: render the
+  model (provenance per cell, worst links, recommended ring order /
+  migration placement) the way ``obs`` renders the ledger.
+
+Pricing lives where pricing already lives:
+``tpu_p2p.models.schedule.price_program(topology=...)`` bills each
+tick's hops per-link instead of uniform busbw units.
+"""
+
+from tpu_p2p.topo.model import Topology
+from tpu_p2p.topo.place import (
+    ordered_devices,
+    ring_min_gbps,
+    ring_order,
+    ring_order_edges,
+    topo_migration_placement,
+)
+
+__all__ = [
+    "Topology",
+    "ring_order",
+    "ring_order_edges",
+    "ring_min_gbps",
+    "ordered_devices",
+    "topo_migration_placement",
+]

@@ -1,0 +1,328 @@
+"""The injected-throttle topology smoke — ``make topo``.
+
+Detectors are graded, not trusted (the ``obs smoke`` rule) — and so
+are optimizers. This smoke makes the whole topology subsystem
+gradeable on a 1-host simulated CPU mesh, end to end:
+
+1. **Inject** a deterministic :class:`~tpu_p2p.obs.faults.FaultPlan`
+   link throttle on the edge ``(n_prefill-1, n_prefill)`` — chosen
+   because it is BOTH a shift-by-1 ring edge and a prefill→decode
+   migration edge of the disagg split, so one fault grades both
+   optimizers.
+2. **Probe** every edge the consumers route over (the ring ∪ the
+   prefill×decode bipartite set) under the plan —
+   :func:`~tpu_p2p.obs.health.probe_link_matrix` compiles fresh under
+   the active plan, so the throttle is visible — and build the
+   :class:`~tpu_p2p.topo.model.Topology`, feeding
+   :func:`~tpu_p2p.obs.health.detect_degraded_links` verdicts in as
+   degraded marks (the health → placement wire, live).
+3. **Route**: the ring-order optimizer must route the cycle around
+   the degraded edge and beat the naive (identity) order's predicted
+   bottleneck (``topo_route_gain = optimized min-link / naive
+   min-link > 1``); the migration placer must keep every migration
+   off the decode shard behind the degraded link while the naive
+   free-pages-first policy lands at least one there, and beat its
+   predicted migration bandwidth (``topo_migrate_gbps_gain > 1``).
+4. **Pin parity**: re-placement must never change computed values —
+   a chunked-wave ship + ``ring_allgather_matmul`` step runs BITWISE
+   identical on the naive and reordered meshes (the order is a device
+   relabel, never a program change), and (under ``engine_parity``)
+   the real disagg engine's token streams under the topo policy are
+   bitwise the naive policy's, with the dry twin event-exact under
+   the injected policy.
+
+→ a dict with the two gate numbers ``bench.py`` publishes
+(``topo_route_gain`` / ``topo_migrate_gbps_gain``) plus per-stage
+results and ``ok``. Needs >= 3 devices — at 2 the ring has one cycle
+and the split one decode shard, so placement is degenerate by
+construction (the bench nulls name exactly this).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+__all__ = ["run_smoke", "DEGENERATE_REASON"]
+
+
+def DEGENERATE_REASON(n: int) -> str:
+    """Why placement cannot be graded on an ``n``-device mesh — ONE
+    wording, shared by the smoke, the CLI, and the bench null."""
+    return (
+        f"placement is degenerate on {n} device(s): a ring needs >= 3 "
+        "devices for a second cycle to exist and the disagg split "
+        "needs >= 2 decode shards to choose between"
+    )
+
+
+def _smoke_serve_shapes(n_prefill: int, n_decode: int):
+    """The tiny disagg serving shape the migration half grades on —
+    the tests/test_serve_disagg.py geometry: 2 decode slots per
+    shard, ample pages (no preemption noise), 6 staggered requests."""
+    from tpu_p2p.config import ServeConfig
+
+    slots = 2 * n_decode
+    max_blocks = 3
+    sc = ServeConfig(
+        slots=slots, page_len=8,
+        num_pages=n_decode * (slots // n_decode * max_blocks + 1),
+        max_blocks=max_blocks, chunk=4, requests=6, seed=0, rate=1.0,
+        prompt_len=(4, 12), gen_len=(4, 8), vocab=64, disagg=True,
+        prefill_tp=n_prefill, prefill_slots=2,
+        prefill_pages=(2 + slots) * max_blocks + 1,
+    )
+    return sc
+
+
+def _smoke_model_cfg(n_prefill: int, sc):
+    """A tiny flagship model whose KV heads divide the prefill tp —
+    the test_serve_disagg convention (GQA 2:1, dense-safe experts)."""
+    from tpu_p2p.models import flagship as F
+
+    kv = max(2, n_prefill)
+    return F.FlagshipConfig(
+        batch=4, seq=16, heads=2 * kv, kv_heads=kv, head_dim=8,
+        stages=2, microbatches=1, num_experts=2, capacity_factor=2.0,
+        vocab=sc.vocab, norm=True, rope=True,
+    )
+
+
+def _ring_parity(devices, order, log) -> bool:
+    """Bitwise pin: one chunked-wave ship + one
+    ``ring_allgather_matmul`` consume, run on the naive mesh and the
+    reordered mesh — identical programs over relabeled devices, so
+    every output byte must match."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from tpu_p2p.parallel import collectives as C
+    from tpu_p2p.topo.place import ordered_devices
+
+    n = len(devices)
+    xg = (np.arange(n * 8 * 4, dtype=np.float32)
+          .reshape(n * 8, 4) / 7.0)
+    got = {}
+    for label, devs in (("naive", list(devices)),
+                        ("topo", ordered_devices(devices, order))):
+        mesh = Mesh(np.array(devs).reshape(n), ("d",))
+
+        def f(xs):
+            y = C.chunked_ppermute_compute(
+                lambda c, i: c * 1.5 + 1.0, xs, "d", C.ring_edges(n),
+                chunk_dim=0, chunks=2, label="topo_smoke_wave")
+            z = C.ring_allgather_matmul(
+                lambda c, s: c * 0.5 + 1.0, xs, "d", 0)
+            return y, jnp.sum(z).reshape(1)
+
+        prog = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=P("d"),
+            out_specs=(P("d"), P("d"))))
+        y, zs = prog(jnp.asarray(xg))
+        got[label] = (np.asarray(jax.device_get(y)),
+                      np.asarray(jax.device_get(zs)))
+    ok = (np.array_equal(got["naive"][0], got["topo"][0])
+          and np.array_equal(got["naive"][1], got["topo"][1]))
+    print(f"# smoke ring parity: wave ship + ring_allgather_matmul "
+          f"bitwise {'OK' if ok else 'FAIL'} under reordered mesh",
+          file=log, flush=True)
+    return ok
+
+
+def run_smoke(*, out=None, engine_parity: bool = True,
+              msg_bytes: int = 256 * 1024, iters: int = 4,
+              repeats: int = 2, degrade_factor: int = 16,
+              artifacts_dir: Optional[str] = None) -> dict:
+    """Run the graded injected-throttle smoke (module docstring); →
+    the result dict (``ok``, ``topo_route_gain``,
+    ``topo_migrate_gbps_gain``, per-stage detail).
+
+    ``engine_parity=False`` skips the real-engine token-stream pin
+    (the bench grader's budget mode — the dry placement comparison
+    and the ring parity still run; ``parity`` then reports what was
+    skipped). ``artifacts_dir`` persists the probed matrix as a
+    ``source: "probe"`` ``MULTICHIP_r*.json``
+    (:func:`tpu_p2p.obs.regress.write_probe_artifact`).
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_p2p.obs import faults
+    from tpu_p2p.obs.health import (
+        detect_degraded_links,
+        probe_link_matrix,
+    )
+    from tpu_p2p.parallel import collectives as C
+    from tpu_p2p.serve.disagg import simulate_disagg_schedule
+    from tpu_p2p.topo import place as PL
+    from tpu_p2p.topo.model import Topology
+
+    log = out if out is not None else sys.stderr
+    devs = jax.devices()
+    n = len(devs)
+    if n < 3:
+        raise RuntimeError(
+            DEGENERATE_REASON(n)
+            + " (force a simulated mesh with --cpu-mesh 8)")
+    n_prefill = max(1, n // 2)
+    n_decode = n - n_prefill
+    # The one throttled edge grades BOTH optimizers: it is ring edge
+    # (n_prefill-1 -> n_prefill) AND the migration link prefill rank
+    # (n_prefill-1) -> decode shard 0.
+    edge = (n_prefill - 1, n_prefill)
+    bad_shard = 0
+    results: dict = {"devices": n, "edge": edge,
+                     "degrade_factor": degrade_factor}
+
+    mesh = Mesh(np.asarray(devs).reshape(n), ("d",))
+    probe_edges = list(C.ring_edges(n))
+    for p in range(n_prefill):
+        for s in range(n_decode):
+            e = (p, n_prefill + s)
+            if e not in probe_edges:
+                probe_edges.append(e)
+    plan = faults.FaultPlan(degrade_edge=edge,
+                            degrade_factor=degrade_factor)
+    print(f"# topo smoke: probing {len(probe_edges)} edge(s) under "
+          f"injected throttle {plan.describe()}", file=log, flush=True)
+    with faults.injecting(plan):
+        mat = probe_link_matrix(mesh, edges=probe_edges,
+                                msg_bytes=msg_bytes, iters=iters,
+                                repeats=repeats)
+    topo = Topology.from_matrix(mat, "probe")
+    flags = detect_degraded_links(mat)
+    topo.mark_degraded(flags)
+    flagged = any(f["src"] == edge[0] and f["dst"] == edge[1]
+                  for f in flags)
+    results["health_flagged"] = flagged
+    print(f"# smoke probe: throttled edge "
+          f"{edge[0]}->{edge[1]} at {topo.link_gbps(*edge):.2f} Gbps "
+          f"vs fleet median {topo.fleet_median():.2f} — health "
+          f"verdict {'fired' if flagged else 'MISSED'}",
+          file=log, flush=True)
+    if artifacts_dir is not None:
+        from tpu_p2p.obs.regress import write_probe_artifact
+
+        path = write_probe_artifact(mat, n, artifacts_dir)
+        print(f"# wrote {path} (source: probe)", file=log, flush=True)
+
+    # ---------------------------------------------------------- ring
+    naive_order = tuple(range(n))
+    opt_order = PL.ring_order(topo)
+    # Published numbers use the REPORTING view (modeled physical
+    # Gbps, penalty off): the gain must be what the wire does, not
+    # the avoidance bias (place.ring_min_gbps docstring).
+    naive_min = PL.ring_min_gbps(topo, naive_order, effective=False)
+    opt_min = PL.ring_min_gbps(topo, opt_order, effective=False)
+    ring_avoided = edge not in PL.ring_order_edges(opt_order)
+    route_gain = opt_min / naive_min if naive_min > 0 else None
+    results["ring"] = {
+        "naive_min_gbps": naive_min, "opt_min_gbps": opt_min,
+        "order": list(opt_order), "avoided": ring_avoided,
+        "topo_route_gain": route_gain,
+    }
+    print(f"# smoke ring: naive min-link {naive_min:.2f} Gbps, "
+          f"optimized {opt_min:.2f} Gbps (order "
+          f"{' '.join(map(str, opt_order))}) — degraded edge "
+          f"avoided={ring_avoided} gain={route_gain:.2f}x",
+          file=log, flush=True)
+    ring_parity_ok = _ring_parity(devs, opt_order, log)
+
+    # ----------------------------------------------------- migration
+    from tpu_p2p.serve.engine import synthetic_trace
+
+    sc = _smoke_serve_shapes(n_prefill, n_decode)
+    cfg = _smoke_model_cfg(n_prefill, sc)
+    trace = synthetic_trace(sc)
+    policy = PL.topo_migration_placement(topo, n_prefill)
+    sims = {}
+    for label, place in (("naive", None), ("topo", policy)):
+        sims[label] = simulate_disagg_schedule(
+            trace, slots=sc.slots, prefill_slots=sc.prefill_slots,
+            page_len=sc.page_len, num_pages=sc.num_pages,
+            prefill_pages=sc.prefill_pages, max_blocks=sc.max_blocks,
+            chunk=sc.chunk, n_decode_shards=n_decode,
+            placement=place, cfg=cfg)
+
+    def predicted(sim):
+        total_b, total_s = 0, 0.0
+        per_block = sim["kv_migrate_bytes"] / max(
+            sum(e["blocks"] for e in sim["migrate_events"]), 1)
+        for e in sim["migrate_events"]:
+            b = int(per_block * e["blocks"])
+            total_b += b
+            total_s += PL.predict_migrate_time_s(
+                topo, n_prefill, e["dst_shard"], b, effective=False)
+        return (total_b * 8 / total_s / 1e9) if total_s > 0 else None
+
+    naive_bad = sum(e["dst_shard"] == bad_shard
+                    for e in sims["naive"]["migrate_events"])
+    topo_bad = sum(e["dst_shard"] == bad_shard
+                   for e in sims["topo"]["migrate_events"])
+    naive_gbps = predicted(sims["naive"])
+    topo_gbps = predicted(sims["topo"])
+    migrate_gain = (topo_gbps / naive_gbps
+                    if naive_gbps and topo_gbps else None)
+    results["migrate"] = {
+        "migrations": len(sims["topo"]["migrate_events"]),
+        "naive_on_degraded": naive_bad, "topo_on_degraded": topo_bad,
+        "naive_pred_gbps": naive_gbps, "topo_pred_gbps": topo_gbps,
+        "topo_migrate_gbps_gain": migrate_gain,
+    }
+    print(f"# smoke migrate: naive places {naive_bad}/"
+          f"{len(sims['naive']['migrate_events'])} migration(s) over "
+          f"the degraded link, topo places {topo_bad}/"
+          f"{len(sims['topo']['migrate_events'])} — predicted Gbps "
+          f"gain {migrate_gain:.2f}x", file=log, flush=True)
+
+    # -------------------------------------------------- engine parity
+    parity = {"ring": ring_parity_ok, "engine": None,
+              "dry_vs_real": None}
+    if engine_parity:
+        from tpu_p2p.models import flagship as F
+        from tpu_p2p.serve.disagg import (
+            build_disagg_meshes,
+            run_disagg_engine,
+        )
+
+        pre, dec, mig = build_disagg_meshes(n_prefill,
+                                            devices=list(devs))
+        seeded = F.init_flagship_params(cfg)
+        p_pre = F.place_flagship_params(seeded, pre)
+        p_dec = F.place_flagship_params(seeded, dec)
+        streams = {}
+        real_events = {}
+        for label, place in (("naive", None), ("topo", policy)):
+            s = run_disagg_engine(pre, dec, mig, cfg, p_pre, p_dec,
+                                  trace, sc=sc, placement=place)
+            streams[label] = {r.rid: list(r.generated)
+                              for r in s["finished"]}
+            real_events[label] = s["migrate_events"]
+        parity["engine"] = (streams["naive"] == streams["topo"]
+                            and len(streams["topo"]) > 0)
+        parity["dry_vs_real"] = (
+            real_events["topo"] == sims["topo"]["migrate_events"])
+        print(f"# smoke engine parity: token streams bitwise "
+              f"{'OK' if parity['engine'] else 'FAIL'} "
+              f"({len(streams['topo'])}/{len(streams['naive'])} "
+              f"requests), dry==real migration events "
+              f"{'OK' if parity['dry_vs_real'] else 'FAIL'}",
+              file=log, flush=True)
+    results["parity"] = parity
+    results["topo_route_gain"] = (round(route_gain, 4)
+                                  if route_gain is not None else None)
+    results["topo_migrate_gbps_gain"] = (
+        round(migrate_gain, 4) if migrate_gain is not None else None)
+    results["ok"] = bool(
+        flagged and ring_avoided
+        and route_gain is not None and route_gain > 1.0
+        and topo_bad == 0 and naive_bad > 0
+        and migrate_gain is not None and migrate_gain > 1.0
+        and ring_parity_ok
+        and parity["engine"] is not False
+        and parity["dry_vs_real"] is not False
+    )
+    return results
