@@ -17,7 +17,7 @@ SHELL    := /bin/bash
 
 NATIVE_SO := native/libtpu_p2p_native.so
 
-.PHONY: all native run test tier1 bench obs topo health serve serve-disagg serve-chaos ckpt-chaos clean
+.PHONY: all native run test tier1 bench obs topo zb health serve serve-disagg serve-chaos ckpt-chaos clean
 
 all: native
 
@@ -61,6 +61,18 @@ obs:
 # mesh so it runs anywhere; override with ARGS= on real hardware.
 topo:
 	$(PYTHON) -m tpu_p2p topo --smoke $(if $(ARGS),$(ARGS),--cpu-mesh 8)
+
+# Zero-bubble schedule smoke (docs/schedule_ir.md): the fused
+# production step (masked tick lowering) vs the zb route under the
+# cost-proportional switch lowering (ZB-H1 weight split — GEMM-only
+# dW ticks against the boundary stash) on a pure-pp mesh — bitwise
+# loss parity pinned, then the wall-clock grade: nonzero exit unless
+# zb beats the fused step where the analytic model says it must
+# (must-not-lose on a single chip, where compile_zb degrades to the
+# fused schedule). Defaults to the simulated 8-device CPU mesh so it
+# runs anywhere; override with ARGS= on real hardware.
+zb:
+	$(PYTHON) -m tpu_p2p zb $(if $(ARGS),$(ARGS),--cpu-mesh 8)
 
 # Injected-fault health smoke (docs/health.md): degraded link,
 # straggler rank, and lost host + self-healing resume, each detected

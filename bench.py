@@ -78,6 +78,7 @@ HEADLINE_KEYS = (
     "pp_overlap_frac",
     "pp_step_ms_overlap_wave",
     "pp_step_ms_sched_zb",
+    "pp_zb_vs_fused_ratio",
     "obs_step_ms_p50",
     "health_detect_steps",
     "p2p_lat_us_pallas",
@@ -1017,6 +1018,11 @@ SCHED_NULL = {
     # "masked" (the fallback, which cannot grade — every rank runs
     # every tick body — so the pair nulls naming it).
     "sched_lowering": None,
+    # zb / fused wall-clock ratio (round 17): < 1.0 wherever the pair
+    # grades. NULL with the reason in sched_error on 1-device meshes
+    # (compile_zb degrades to the fused schedule there, so the ratio
+    # is the degenerate 1.0 — the multi-chip harvest convention).
+    "pp_zb_vs_fused_ratio": None,
     "sched_source": None,
     "sched_error": None,
 }
@@ -1097,6 +1103,7 @@ def _pp_sched_metrics(timing):
         out["sched_error"] = f"{type(e).__name__}: {e}"
         out["pp_step_ms_sched_1f1b"] = None
         out["pp_step_ms_sched_zb"] = None
+        out["pp_zb_vs_fused_ratio"] = None
         out["sched_source"] = None
         print(f"# pp sched measured half failed: {e!r}",
               file=sys.stderr)
@@ -1230,6 +1237,24 @@ def _pp_sched_measured(timing, mesh, n):
             f"zb (switch lowering) lost on the measured step: "
             f"{out['pp_step_ms_sched_zb']} ms vs "
             f"{out['pp_step_ms_sched_1f1b']} ms (1f1b fused)"
+        )
+    # The dimensionless twin of the graded pair (round 17): the
+    # regress gate watches the RATIO so a machine-wide slowdown that
+    # moves both arms in lockstep does not page, only a shift in the
+    # zb-vs-fused relationship does. Publishes only where the pair
+    # actually grades (pp>1); the 1-chip degenerate nulls it with the
+    # reason in sched_error, per the multi-chip harvest convention.
+    if n > 1:
+        out["pp_zb_vs_fused_ratio"] = round(
+            out["pp_step_ms_sched_zb"] / out["pp_step_ms_sched_1f1b"],
+            4)
+    else:
+        out["sched_error"] = (
+            "pp_zb_vs_fused_ratio nulls on a 1-device mesh: "
+            "compile_zb degrades to the fused schedule, so the ratio "
+            "is the degenerate 1.0 and grades nothing (the measured "
+            "pair above still publishes under the must-not-lose "
+            "criterion)"
         )
     return out
 

@@ -968,6 +968,8 @@ def test_pp_sched_metrics_cpu_mesh(monkeypatch):
     assert out["pp_step_ms_sched_1f1b"] == pytest.approx(3.0)
     assert out["pp_step_ms_sched_zb"] == pytest.approx(2.0)
     assert out["pp_step_ms_sched_1f1b_switch"] == pytest.approx(1.5)
+    assert out["pp_zb_vs_fused_ratio"] == pytest.approx(2.0 / 3.0,
+                                                        abs=1e-3)
     assert out["sched_lowering"] == "switch"
     assert out["sched_source"] == "host_differential"
     assert out["sched_error"] is None
@@ -1001,6 +1003,8 @@ def test_pp_sched_measured_grades_the_switch_pair(monkeypatch):
     assert out["pp_step_ms_sched_1f1b"] == pytest.approx(6.0)
     assert out["pp_step_ms_sched_zb"] == pytest.approx(2.0)
     assert out["pp_step_ms_sched_1f1b_switch"] == pytest.approx(3.0)
+    assert out["pp_zb_vs_fused_ratio"] == pytest.approx(2.0 / 6.0,
+                                                        abs=1e-3)
     assert out["sched_lowering"] == "switch"
     assert "sched_error" not in out
 
@@ -1023,9 +1027,36 @@ def test_pp_sched_measured_masked_fallback_names_the_lowering(
     out = bench._pp_sched_measured(None, mesh, 8)
     assert out["pp_step_ms_sched_1f1b"] is None
     assert out["pp_step_ms_sched_zb"] is None
+    # A nulled pair cannot carry a ratio — the key stays at its
+    # SCHED_NULL None in the merged metric dict.
+    assert "pp_zb_vs_fused_ratio" not in out
     assert out["sched_lowering"] == "masked"
     assert "switch arm exploded" in out["sched_error"]
     assert "masked" in out["sched_error"]
+
+
+def test_pp_sched_measured_ratio_nulls_with_reason_on_one_device(
+        monkeypatch):
+    # Round-17 satellite: pp_zb_vs_fused_ratio is the gated
+    # dimensionless twin of the step pair, but on a 1-device mesh
+    # compile_zb degrades to the fused schedule — the ratio is the
+    # degenerate 1.0 and grades nothing — so it NULLs with the
+    # reason published (the multi-chip harvest convention), while
+    # the step pair itself still publishes under must-not-lose.
+    from jax.sharding import Mesh
+
+    import jax
+    import numpy as np
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1), ("pp",))
+    monkeypatch.setattr(bench, "_pp_sched_arm",
+                        _fake_sched_arm())
+    out = bench._pp_sched_measured(None, mesh, 1)
+    assert out["pp_step_ms_sched_1f1b"] == pytest.approx(6.0)
+    assert out["pp_step_ms_sched_zb"] == pytest.approx(2.0)
+    assert "pp_zb_vs_fused_ratio" not in out
+    assert "1-device" in out["sched_error"]
+    assert "pp_zb_vs_fused_ratio" in out["sched_error"]
 
 
 def test_pp_sched_measured_zb_loss_is_a_real_failure(monkeypatch):
@@ -1087,6 +1118,12 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         # constant) left in the round-19 trade for the topology pair
         # (test_round19_budget_trade).
         "pp_step_ms_sched_zb": 98.765,
+        # Round 17 (ZB-H1 weight split): the dimensionless zb/fused
+        # ratio joined the line next to its absolute twin — it nulls
+        # with the reason on 1-device rounds (compile_zb degrades to
+        # the fused schedule there), so a realistic populated round
+        # carries a sub-1.0 four-decimal ratio.
+        "pp_zb_vs_fused_ratio": 0.6789,
         "obs_step_ms_p50": 123.456,
         # Round 12: the health pair joined the line; "devices" (the
         # byte-identical twin of the line's own top-level "n") and

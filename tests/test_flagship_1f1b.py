@@ -115,6 +115,32 @@ def test_1f1b_flagship_validation():
                                         _cfg(zero_dp=True))
 
 
+def test_zb_schedule_accept_and_reject_routes():
+    # Accept: the tick-IR executor owns pp_schedule="zb" (ZB-H1 weight
+    # split) and tick_lowering="switch" — both constructors build.
+    mesh = _mesh(pp=2)
+    F.make_flagship_train_step_1f1b(mesh, _cfg(pp_schedule="zb"))
+    F.make_flagship_train_step_1f1b(
+        mesh, _cfg(pp_schedule="zb", tick_lowering="switch"))
+    # Reject: zb x interleaved virtual stages (ZB-V is out of scope) —
+    # the error names the supported chunks=1 route.
+    with pytest.raises(ValueError, match="chunks=1"):
+        F.make_flagship_train_step_1f1b(mesh, _cfg(pp_schedule="zb"),
+                                        chunks=2)
+    # Reject: the GPipe autodiff steps have no backward ticks to
+    # split — their errors point at the tick-IR route, not the
+    # retired manual executor.
+    with pytest.raises(ValueError, match="tick-IR"):
+        F.make_flagship_train_step(mesh, _cfg(pp_schedule="zb"))
+    with pytest.raises(ValueError, match="tick-IR"):
+        F.make_flagship_train_step(mesh, _cfg(tick_lowering="switch"))
+    # Reject: switch dispatch needs a permute-free stage block (rank-
+    # divergent branches deadlock a whole-mesh collective-permute).
+    with pytest.raises(ValueError, match="permute"):
+        F.make_flagship_train_step_1f1b(
+            _mesh(pp=2, sp=2), _cfg(tick_lowering="switch"))
+
+
 def test_pipelined_stage_perm_roundtrip():
     cfg = _cfg(stages=8)
     mesh = _mesh(pp=2)

@@ -150,7 +150,28 @@ def test_lint_scans_the_expected_trees():
             "topo/smoke.py — extend SCANNED (and this self-test) to "
             "wherever they went"
         )
-    assert len(files) >= 23, files
+    # Round 17: the ZB-H1 weight split (models/zb_split.py) replays
+    # the captured backward jaxpr with eqn.primitive.bind — the one
+    # place in the models tree that issues primitives WITHOUT a
+    # dotted jax.lax call for the grep to see. The replay itself only
+    # re-binds what the ledger-wrapped block traced (so it cannot
+    # smuggle new transport), but a hand-written collective added
+    # alongside it WOULD be a raw call — the module (and the `make
+    # zb` smoke next to it) must stay inside the scanned tree, and
+    # the two-phase machinery must actually live there.
+    assert "zb_split.py" in names and "zb_smoke.py" in names, \
+        sorted(names)
+    zb_src = next(p for p in files
+                  if os.path.basename(p) == "zb_split.py")
+    with open(zb_src) as fh:
+        zb_text = fh.read()
+    assert "primitive.bind" in zb_text \
+        and "split_backward" in zb_text, (
+            "the ZB-H1 two-phase replay moved out of "
+            "models/zb_split.py — extend SCANNED (and this "
+            "self-test) to wherever it went"
+        )
+    assert len(files) >= 25, files
 
 
 # ---------------------------------------------------- pallas transport

@@ -409,6 +409,64 @@ def test_flagship_step_bitwise_under_reordered_mesh(names, shape, kw):
                                       got["topo"][1][k], err_msg=k)
 
 
+def test_make_runtime_threads_ring_order_and_step_stays_bitwise():
+    # The ROADMAP fleet-serving follow-up: make_runtime's default 1D
+    # mesh picks up topo.place's recommended ring order (injected
+    # here; production reads the MULTICHIP harvest history). The
+    # reorder is a pure relabeling — one pipeline SGD step on the
+    # reordered world is bitwise the enumeration-order world's.
+    from tpu_p2p.models import pipeline as PIPE
+    from tpu_p2p.parallel.runtime import make_runtime
+
+    t = Topology.preset_uniform(8, 100.0)
+    t.gbps[3][4] = 1.0  # slow link -> non-identity optimum
+    order = PL.ring_order(t)
+    assert order != tuple(range(8))
+
+    rt_topo = make_runtime(num_devices=8, axis_names=("pp",),
+                           ring_topology=t)
+    rt_raw = make_runtime(num_devices=8, axis_names=("pp",),
+                          apply_ring_order=False)
+    assert [d.id for d in rt_topo.devices] == \
+        [rt_raw.devices[i].id for i in order]
+
+    cfg, params, x, target = conftest.pipeline_setup(stages=8, m=4)
+    got = {}
+    for label, rt in (("topo", rt_topo), ("raw", rt_raw)):
+        placed = PIPE.place_pipeline_params(params, rt.mesh)
+        new_p, loss = PIPE.make_pipeline_train_step(
+            rt.mesh, cfg, lr=5e-2)(placed, x, target)
+        got[label] = (float(loss),
+                      {k: np.asarray(jax.device_get(v))
+                       for k, v in new_p.items()})
+    assert got["topo"][0] == got["raw"][0]
+    for k in got["raw"][1]:
+        np.testing.assert_array_equal(got["topo"][1][k],
+                                      got["raw"][1][k], err_msg=k)
+
+
+def test_make_runtime_ring_order_leaves_small_and_2d_worlds_alone():
+    # n <= 2 has one cycle; explicit mesh_shape worlds encode physical
+    # structure the ring objective must not scramble.
+    from tpu_p2p.parallel.runtime import make_runtime
+
+    t = Topology.preset_uniform(8, 100.0)
+    t.gbps[3][4] = 1.0
+    rt2 = make_runtime(num_devices=2, ring_topology=t)
+    assert [d.id for d in rt2.devices] == \
+        [d.id for d in jax.devices()[:2]]
+    rt2d = make_runtime(num_devices=8, mesh_shape=(4, 2),
+                        axis_names=("x", "y"), ring_topology=t)
+    assert [d.id for d in rt2d.devices] == \
+        [d.id for d in jax.devices()[:8]]
+    # A size-mismatched (or absent) topology falls back to enumeration
+    # order instead of breaking bootstrap.
+    t4 = Topology.preset_uniform(4)
+    rt_mismatch = make_runtime(num_devices=8, ring_topology=t4)
+    assert [d.id for d in rt_mismatch.devices] == \
+        [d.id for d in jax.devices()[:8]]
+
+
 def test_wave_and_allgather_ring_bitwise_under_reordered_mesh():
     # The transport-level twin of the flagship pin, on the exact ship
     # sites the optimizer retargets (chunked_ppermute_compute waves +

@@ -132,13 +132,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pp-schedule", choices=PP_SCHEDULES,
                    default="1f1b",
                    help="flagship_step: pipeline tick schedule under "
-                        "the manual executor (zb = zero-bubble dB/dW "
-                        "split — weight-grad ticks fill the 1F1B "
-                        "bubbles, step bitwise vs 1f1b; routes the "
-                        "workload through the manual 1F1B executor)")
+                        "the tick-IR executor (zb = zero-bubble "
+                        "ZB-H1 weight split — GEMM-only dW ticks "
+                        "fill the 1F1B bubbles, step bitwise vs "
+                        "1f1b; routes the workload through the "
+                        "tick-IR 1F1B executor)")
     p.add_argument("--tick-lowering", choices=TICK_LOWERINGS,
                    default="masked",
-                   help="flagship_step: tick lowering for the manual "
+                   help="flagship_step: tick lowering for the IR "
                         "executor's compiled programs (switch = "
                         "cost-proportional per-rank lax.switch "
                         "dispatch — idle ranks genuinely idle, step "
@@ -334,6 +335,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_p2p.topo.cli import main as topo_main
 
         return topo_main(list(argv[1:]))
+    if argv and argv[0] == "zb":
+        # ``python -m tpu_p2p zb`` — the graded zero-bubble schedule
+        # smoke (tpu_p2p/models/zb_smoke.py, docs/schedule_ir.md):
+        # fused production step vs the zb route under the switch tick
+        # lowering, bitwise loss parity plus the wall-clock grade.
+        # Dispatched like obs/serve/topo: its own flag set and
+        # exit-code contract (nonzero unless zb beats the fused step).
+        from tpu_p2p.models.zb_smoke import main as zb_main
+
+        return zb_main(list(argv[1:]))
     if argv and argv[0] == "train":
         # ``python -m tpu_p2p train`` — the training loop
         # (tpu_p2p/train.py: durable checkpoint/resume, --heal,

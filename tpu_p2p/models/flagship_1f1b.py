@@ -1,8 +1,10 @@
-"""Flagship under the manual (interleaved) 1F1B pipeline executor.
+"""Flagship under the tick-IR 1F1B / interleaved / zero-bubble executor.
 
 Split from flagship.py (round 2); see :mod:`tpu_p2p.models.flagship`
-for the model overview and
-:mod:`tpu_p2p.models.pipeline_interleaved` for the schedule machinery.
+for the model overview and :mod:`tpu_p2p.models.schedule` for the
+tick-schedule IR every pp_schedule now lowers through. The legacy
+manual executor (:mod:`tpu_p2p.models.pipeline_interleaved`) survives
+as a parity fixture only.
 """
 
 from __future__ import annotations
@@ -94,12 +96,15 @@ class FlagshipPipelined:
 
 def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
                                   lr: float = 1e-2, chunks: int = 1):
-    """The flagship step under the manual (interleaved) 1F1B executor.
+    """The flagship step under the tick-IR 1F1B executor.
 
-    The capstone composition: pipeline ticks from
-    :mod:`tpu_p2p.models.pipeline_interleaved` (manual per-tick
-    ``jax.vjp`` with rematerialized forwards, O(S)-bounded activation
-    stash) whose stage block runs the full transformer sub-block —
+    The capstone composition: pipeline ticks from the schedule IR
+    (:mod:`tpu_p2p.models.schedule` — ``compile_* -> lower() ->
+    tick_grads_local``, manual per-tick ``jax.vjp`` with
+    rematerialized forwards, O(S)-bounded activation stash; under
+    ``pp_schedule='zb'`` the jaxpr-partitioned ZB-H1 backward split of
+    :mod:`tpu_p2p.models.zb_split`) whose stage block runs the full
+    transformer sub-block —
     ring/Ulysses sp attention, Megatron tp ``psum``, MoE ep
     ``all_to_all`` — inside the vjp. Gradient accounting under manual
     backprop: ``jax.vjp`` *inside* shard_map already inserts the
@@ -113,10 +118,6 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
     (ZeRO's gather-on-use transpose needs autodiff owning the params).
     """
     from tpu_p2p.models.pipeline_1f1b import _mse_loss_grad
-    from tpu_p2p.models.pipeline_interleaved import (
-        build_interleaved_schedule,
-        interleaved_grads_local,
-    )
 
     if cfg.pp_schedule == "zb" and chunks != 1:
         raise ValueError(
@@ -181,28 +182,25 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
             f"chunks ({chunks})"
         )
     s_chunk = cfg.stages // (n * chunks)
-    use_ir = cfg.pp_schedule == "zb" or cfg.tick_lowering != "masked"
-    if use_ir:
-        # The unified IR executor (tpu_p2p/models/schedule.py) owns
-        # both the zero-bubble program (bitwise the fused "1f1b" step
-        # — per-stage dW accumulation order is preserved — with the
-        # backward split so weight-grad ticks fill the schedule's
-        # bubbles) and the cost-proportional tick_lowering="switch"
-        # dispatch (bitwise the masked execution, idle ranks
-        # genuinely idle) — so a switch-lowered "1f1b" run compiles
-        # the fused program through the same IR (docs/schedule_ir.md).
-        from tpu_p2p.models.schedule import (
-            compile_interleaved,
-            compile_zb,
-            lower,
-        )
+    # Every schedule flows through the tick IR (tpu_p2p/models/
+    # schedule.py): compile_* -> lower() -> tick_grads_local. The IR
+    # owns the zero-bubble program (bitwise the fused "1f1b" step —
+    # per-stage dW accumulation order is preserved — with the backward
+    # split so weight-grad ticks fill the schedule's bubbles) and the
+    # cost-proportional tick_lowering="switch" dispatch (bitwise the
+    # masked execution, idle ranks genuinely idle). The legacy manual
+    # executor (pipeline_interleaved.interleaved_grads_local) survives
+    # only as a parity fixture (docs/schedule_ir.md).
+    from tpu_p2p.models.schedule import (
+        compile_interleaved,
+        compile_zb,
+        lower,
+    )
 
-        prog = (compile_zb(cfg.microbatches, n)
-                if cfg.pp_schedule == "zb"
-                else compile_interleaved(cfg.microbatches, n, chunks))
-        lowered = lower(prog, tick_lowering=cfg.tick_lowering)
-    else:
-        sched = build_interleaved_schedule(cfg.microbatches, n, chunks)
+    prog = (compile_zb(cfg.microbatches, n)
+            if cfg.pp_schedule == "zb"
+            else compile_interleaved(cfg.microbatches, n, chunks))
+    lowered = lower(prog, tick_lowering=cfg.tick_lowering)
     sp, tp, ep = axes.get("sp"), axes.get("tp"), axes.get("ep")
     specs = flagship_param_specs(mesh, cfg)
     n_out = cfg.batch * cfg.seq * cfg.model_dim
@@ -242,22 +240,14 @@ def make_flagship_train_step_1f1b(mesh: Mesh, cfg: FlagshipConfig,
         mb = b_loc // cfg.microbatches
         x_mb = x.reshape((cfg.microbatches, mb) + x.shape[1:])
         t_mb = target.reshape((cfg.microbatches, mb) + target.shape[1:])
-        if use_ir:
-            from tpu_p2p.models.schedule import tick_grads_local
+        from tpu_p2p.models.schedule import tick_grads_local
 
-            loss_sum, grads = tick_grads_local(
-                block_fn, _mse_loss_grad, params, x_mb, t_mb, lowered,
-                "pp", chunk_rows=s_chunk, vma_axes=data_axes,
-                dparam_vma=dparam_vma, pp_overlap=cfg.pp_overlap,
-                pp_chunks=cfg.pp_chunks,
-            )
-        else:
-            loss_sum, grads = interleaved_grads_local(
-                block_fn, _mse_loss_grad, params, x_mb, t_mb, sched,
-                "pp", chunk_rows=s_chunk, vma_axes=data_axes,
-                dparam_vma=dparam_vma, pp_overlap=cfg.pp_overlap,
-                pp_chunks=cfg.pp_chunks,
-            )
+        loss_sum, grads = tick_grads_local(
+            block_fn, _mse_loss_grad, params, x_mb, t_mb, lowered,
+            "pp", chunk_rows=s_chunk, vma_axes=data_axes,
+            dparam_vma=dparam_vma, pp_overlap=cfg.pp_overlap,
+            pp_chunks=cfg.pp_chunks,
+        )
         if data_axes:
             loss_sum = C.psum(loss_sum, data_axes, label="loss_allreduce")
         return _sgd_update(params, grads, lr, n_out), loss_sum / n_out

@@ -47,23 +47,30 @@ def _reject_zb_schedule(cfg: FlagshipConfig) -> None:
     autodiff owns their backward, so there is no dB/dW tick to split;
     a ``pp_schedule="zb"`` run here would silently time the autodiff
     baseline while its logs claim zero-bubble (the strict-knob class
-    every overlap validation guards). The manual executor
-    (:func:`tpu_p2p.models.flagship_1f1b.make_flagship_train_step_1f1b`)
-    honors the knob. ``tick_lowering="switch"`` is rejected for the
-    same reason: the cost-proportional dispatch is a property of the
-    IR executor's tick tables — the GPipe scan here is a masked
-    schedule autodiff owns, and a switch label on it would silently
-    time the masked baseline."""
+    every overlap validation guards). The supported route is the
+    tick-IR executor
+    (:func:`tpu_p2p.models.flagship_1f1b.make_flagship_train_step_1f1b`,
+    which lowers every schedule — fused, zb, switch — through
+    ``tpu_p2p.models.schedule.lower()``; the zb program runs the
+    jaxpr-partitioned ZB-H1 weight split of
+    :mod:`tpu_p2p.models.zb_split`). ``tick_lowering="switch"`` is
+    rejected here for the same reason: the cost-proportional dispatch
+    is a property of the IR executor's tick tables — the GPipe scan
+    is a masked schedule autodiff owns, and a switch label on it
+    would silently time the masked baseline."""
     if cfg.pp_schedule == "zb":
         raise ValueError(
-            "pp_schedule='zb' requires the manual 1F1B executor "
-            "(make_flagship_train_step_1f1b); the GPipe autodiff "
-            "steps have no backward ticks to split"
+            "pp_schedule='zb' runs on the switch-lowered tick-IR "
+            "executor (make_flagship_train_step_1f1b, which compiles "
+            "zb through schedule.lower() with the ZB-H1 weight "
+            "split); the GPipe autodiff steps have no backward ticks "
+            "to split"
         )
     if cfg.tick_lowering != "masked":
         raise ValueError(
-            f"tick_lowering={cfg.tick_lowering!r} requires the manual "
-            "1F1B executor (make_flagship_train_step_1f1b); the GPipe "
+            f"tick_lowering={cfg.tick_lowering!r} runs on the tick-IR "
+            "executor (make_flagship_train_step_1f1b, which lowers "
+            "every schedule through schedule.lower()); the GPipe "
             "autodiff steps run a masked scan with no per-rank tick "
             "timeline to dispatch over"
         )

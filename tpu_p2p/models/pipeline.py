@@ -171,14 +171,27 @@ def _to_microbatches(x, m: int):
 def make_pipeline_forward(mesh: Mesh, cfg: PipelineConfig,
                           block_fn: Callable = mlp_block,
                           pp_overlap: str = "none", pp_chunks: int = 1):
-    """Jitted pipeline forward: global ``[B, T, D]`` in and out."""
+    """Jitted pipeline forward: global ``[B, T, D]`` in and out.
+
+    Runs the GPipe program through the tick-schedule IR
+    (``compile_gpipe -> lower() -> tick_forward_local``) — bitwise the
+    legacy hand-rolled scan (:func:`pipeline_apply_local`, kept as a
+    parity fixture; tests/test_schedule.py pins the equivalence).
+    """
+    from tpu_p2p.models.schedule import (
+        compile_gpipe,
+        lower,
+        tick_forward_local,
+    )
+
     pp = _check_pp_mesh(mesh, cfg)
+    lowered = lower(compile_gpipe(cfg.microbatches, cfg.stages))
 
     def f(params, x):
         x_mb = _to_microbatches(x, cfg.microbatches)
-        y_mb = pipeline_apply_local(block_fn, params, x_mb, pp,
-                                    pp_overlap=pp_overlap,
-                                    pp_chunks=pp_chunks)
+        y_mb = tick_forward_local(block_fn, params, x_mb, lowered, pp,
+                                  pp_overlap=pp_overlap,
+                                  pp_chunks=pp_chunks)
         return y_mb.reshape(x.shape)
 
     sm = jax.shard_map(
@@ -203,7 +216,31 @@ def _check_pp_mesh(mesh: Mesh, cfg: PipelineConfig) -> str:
 def make_pipeline_train_step(mesh: Mesh, cfg: PipelineConfig,
                              block_fn: Callable = mlp_block, lr: float = 1e-2,
                              pp_overlap: str = "none", pp_chunks: int = 1):
-    """One jitted SGD step through the pipeline schedule."""
+    """One jitted SGD step through the pipeline schedule.
+
+    Routed through the tick-schedule IR (``compile_gpipe -> lower()``;
+    autodiff owns the backward through the tick scan) — bitwise the
+    legacy executor, which survives as the
+    :func:`make_pipeline_train_step_reference` parity fixture.
+    """
+    from tpu_p2p.models.schedule import compile_gpipe, make_tick_train_step
+
+    _check_pp_mesh(mesh, cfg)
+    return make_tick_train_step(
+        mesh, cfg, compile_gpipe(cfg.microbatches, cfg.stages),
+        block_fn=block_fn, lr=lr, pp_overlap=pp_overlap,
+        pp_chunks=pp_chunks)
+
+
+def make_pipeline_train_step_reference(mesh: Mesh, cfg: PipelineConfig,
+                                       block_fn: Callable = mlp_block,
+                                       lr: float = 1e-2,
+                                       pp_overlap: str = "none",
+                                       pp_chunks: int = 1):
+    """Parity fixture: the legacy hand-rolled GPipe step (autodiff over
+    :func:`pipeline_apply_local`'s tick-counter scan). Production code
+    goes through :func:`make_pipeline_train_step`; tests pin this
+    fixture bitwise against the IR path."""
     pp = _check_pp_mesh(mesh, cfg)
 
     def step(params, x, target):

@@ -468,7 +468,45 @@ def make_interleaved_train_step(mesh: Mesh, cfg: PipelineConfig,
     ``cfg.stages`` must equal ``pp_size · chunks``; params use the
     device-major layout (:func:`place_interleaved_params`). Matches the
     GPipe/plain-1F1B steps' loss normalization and update rule.
+
+    Routed through the tick-schedule IR (``compile_interleaved ->
+    lower() -> tick_grads_local``) — bitwise the legacy manual
+    executor, which survives as the
+    :func:`make_interleaved_train_step_reference` parity fixture
+    (tests/test_schedule.py pins the equivalence).
     """
+    from tpu_p2p.models.schedule import (
+        compile_interleaved,
+        make_tick_train_step,
+    )
+
+    pp = "pp" if "pp" in mesh.axis_names else None
+    if pp is None:
+        raise ValueError("mesh needs a 'pp' axis for pipeline parallelism")
+    n = mesh.shape[pp]
+    if cfg.stages != n * chunks:
+        raise ValueError(
+            f"stages ({cfg.stages}) must equal pp size ({n}) x chunks "
+            f"({chunks})"
+        )
+    return make_tick_train_step(
+        mesh, cfg, compile_interleaved(cfg.microbatches, n, chunks),
+        block_fn=block_fn, lr=lr, loss_grad_fn=loss_grad_fn,
+        pp_overlap=pp_overlap, pp_chunks=pp_chunks)
+
+
+def make_interleaved_train_step_reference(mesh: Mesh, cfg: PipelineConfig,
+                                          chunks: int,
+                                          block_fn: Callable = mlp_block,
+                                          lr: float = 1e-2,
+                                          loss_grad_fn: Callable =
+                                          _mse_loss_grad,
+                                          pp_overlap: str = "none",
+                                          pp_chunks: int = 1):
+    """Parity fixture: the legacy manual interleaved-1F1B step
+    (:func:`interleaved_grads_local`'s hand-rolled tick scan).
+    Production code goes through :func:`make_interleaved_train_step`;
+    tests pin this fixture bitwise against the IR path."""
     pp = "pp" if "pp" in mesh.axis_names else None
     if pp is None:
         raise ValueError("mesh needs a 'pp' axis for pipeline parallelism")

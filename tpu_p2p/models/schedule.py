@@ -108,13 +108,18 @@ _SWITCH_KIND_ORDER = ("fwd", "bwd", "bwd_input", "bwd_weight")
 
 # Analytic op costs in forward-units: the fused backward computes both
 # dx and dW against a rematerialized forward (~2x the forward's
-# FLOPs); the split halves each carry one of them. Bubble fractions
-# derived from these are schedule properties, not measurements.
+# FLOPs). Under the true ZB-H1 split (tpu_p2p/models/zb_split.py) the
+# fused backward trace is PARTITIONED, not re-run: ``bwd_input``
+# carries the remat + dx chain (~1 forward-unit) and ``bwd_weight``
+# replays only the dW GEMM contractions against the stashed boundary —
+# roughly one GEMM per layer where the forward pays one GEMM plus the
+# activation chain, hence below 1.0. Bubble fractions derived from
+# these are schedule properties, not measurements.
 OP_COST = {
     "fwd": 1.0,
     "bwd": 2.0,
     "bwd_input": 1.0,
-    "bwd_weight": 1.0,
+    "bwd_weight": 0.5,
 }
 
 OP_KINDS = tuple(OP_COST)
@@ -540,6 +545,12 @@ class LoweredProgram:
     tables: Dict[str, np.ndarray]
     lowering: str = "masked"
     op_table: Tuple[str, ...] = ("noop",)
+    # Split programs only: slot count of the boundary stash — the
+    # phase1→phase2 values (per-layer cotangents + the activations
+    # each dW contraction reads; tpu_p2p/models/zb_split.py) parked
+    # between a microbatch's bwd_input and bwd_weight ticks,
+    # interval-colored like the activation/gradient stashes.
+    bnd_slots: int = 0
 
 
 def _op_ticks(program: TickProgram):
@@ -599,11 +610,13 @@ def lower(program: TickProgram,
     (:func:`~tpu_p2p.models.pipeline_1f1b._color_intervals`), so a
     program compiled from the legacy schedule lowers to the legacy
     slot assignment exactly — the bitwise IR-vs-executor contract.
-    For split programs the activation lives until its ``bwd_weight``
-    read and the incoming gradient is re-read there too (the last
-    virtual stage's loss gradient is written into the gradient stash
-    at its ``bwd_input`` tick, so the ``bwd_weight`` tick reads every
-    stage's cotangent the same way).
+    Split programs keep the fused activation/gradient lifetimes (both
+    stashes release at the ``bwd_input`` tick — phase1 consumes them
+    there); what the deferred ``bwd_weight`` tick reads instead is the
+    boundary stash (``b_bnd`` write slot at the Bi tick, ``w_bnd``
+    read slot at the W tick), interval-colored over each microbatch's
+    Bi→W span and holding exactly the phase1→phase2 values of the
+    split backward (tpu_p2p/models/zb_split.py).
 
     ``tick_lowering="switch"`` additionally emits the per-rank
     ``op_code`` timeline over the program's compact ``op_table`` (see
@@ -659,32 +672,33 @@ def lower(program: TickProgram,
         raise ValueError(f"{program.name}: fwd/bwd ops missing")
     if split and (w_tick < 0).any():
         raise ValueError(f"{program.name}: bwd_weight ops missing")
-    last_read = w_tick if split else bwd_tick
 
     # Interval coloring, per device, in the legacy builder's exact
-    # construction order (chunk-major then microbatch).
-    act_slots, grad_slots = 0, 1
+    # construction order (chunk-major then microbatch). Activation and
+    # gradient lifetimes are fused-shaped even for split programs —
+    # phase1 drains both at the bwd_input tick; only the boundary
+    # stash (below) spans Bi→W.
+    act_slots, grad_slots, bnd_slots = 0, 1, 0
     act_assign: Dict = {}
     grad_assign: Dict = {}
+    bnd_assign: Dict = {}
     for d in range(n):
         act_iv: List[Tuple[int, int, object]] = []
         grad_iv: List[Tuple[int, int, object]] = []
+        bnd_iv: List[Tuple[int, int, object]] = []
         for c in range(v):
             sv = d + c * n
             for mb in range(m):
                 w = (fwd_tick[sv, mb] if sv == 0
                      else fwd_tick[sv - 1, mb] + 1)
-                act_iv.append((int(w), int(last_read[sv, mb]),
+                act_iv.append((int(w), int(bwd_tick[sv, mb]),
                                (sv, mb)))
                 if sv < s_virt - 1:
                     grad_iv.append((int(bwd_tick[sv + 1, mb] + 1),
-                                    int(last_read[sv, mb]), (sv, mb)))
-                elif split:
-                    # Last virtual stage under the split: the loss
-                    # gradient is stashed at the Bi tick and re-read
-                    # at the W tick.
-                    grad_iv.append((int(bwd_tick[sv, mb]),
-                                    int(w_tick[sv, mb]), (sv, mb)))
+                                    int(bwd_tick[sv, mb]), (sv, mb)))
+                if split:
+                    bnd_iv.append((int(bwd_tick[sv, mb]),
+                                   int(w_tick[sv, mb]), (sv, mb)))
         cnt, assign = _color_intervals(act_iv)
         act_slots = max(act_slots, cnt)
         act_assign.update(assign)
@@ -692,12 +706,16 @@ def lower(program: TickProgram,
             cnt, assign = _color_intervals(grad_iv)
             grad_slots = max(grad_slots, cnt)
             grad_assign.update(assign)
+        if bnd_iv:
+            cnt, assign = _color_intervals(bnd_iv)
+            bnd_slots = max(bnd_slots, cnt)
+            bnd_assign.update(assign)
 
     tables = {
         k: np.full((T, n), -1, np.int32)
         for k in ("f_mb", "f_cidx", "f_slot", "b_mb", "b_cidx",
                   "b_slot", "recv_slot", "b_gslot", "grecv_slot",
-                  "w_mb", "w_cidx", "w_slot", "w_gslot")
+                  "w_mb", "w_cidx", "b_bnd", "w_bnd")
     }
     for sv in range(s_virt):
         d, c = sv % n, sv // n
@@ -715,15 +733,30 @@ def lower(program: TickProgram,
                 gs = grad_assign[(sv, mb)]
                 tables["b_gslot"][bwd_tick[sv, mb], d] = gs
                 tables["grecv_slot"][bwd_tick[sv + 1, mb] + 1, d] = gs
-            elif split:
-                gs = grad_assign[(sv, mb)]
-                tables["b_gslot"][bwd_tick[sv, mb], d] = gs
             if split:
-                gs = grad_assign[(sv, mb)]
+                bs = bnd_assign[(sv, mb)]
+                tables["b_bnd"][bwd_tick[sv, mb], d] = bs
                 tables["w_mb"][w_tick[sv, mb], d] = mb
                 tables["w_cidx"][w_tick[sv, mb], d] = c
-                tables["w_slot"][w_tick[sv, mb], d] = slot
-                tables["w_gslot"][w_tick[sv, mb], d] = gs
+                tables["w_bnd"][w_tick[sv, mb], d] = bs
+    # Per-tick hop elision: a tick with no fwd op anywhere has nothing
+    # riding the activation hop (every receive-table entry points at a
+    # tick FOLLOWING a real op, so an elided hop's payload is never
+    # read) — likewise the gradient hop on ticks with no bwd/bwd_input
+    # op. Whole-tick properties, identical on every rank, so the
+    # executor can skip the collective without a rank-divergent
+    # branch. This is where the split schedule stops paying for its
+    # longer tick timeline: zb's W-rich drain ticks ship nothing.
+    ship_y = np.zeros((T,), np.int32)
+    ship_g = np.zeros((T,), np.int32)
+    for t, tick_ in enumerate(program.ticks):
+        for op in tick_.compute:
+            if op.kind == "fwd":
+                ship_y[t] = 1
+            elif op.kind in ("bwd", "bwd_input"):
+                ship_g[t] = 1
+    tables["ship_y"] = ship_y
+    tables["ship_g"] = ship_g
     if op_code is not None:
         tables["op_code"] = op_code
     return LoweredProgram(
@@ -731,6 +764,7 @@ def lower(program: TickProgram,
         act_slots=act_slots, grad_slots=grad_slots,
         fwd_edges=tuple(fwd_edges), bwd_edges=tuple(bwd_edges),
         tables=tables, lowering=tick_lowering, op_table=op_table,
+        bnd_slots=bnd_slots,
     )
 
 
@@ -853,13 +887,17 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
     - fused programs (``bwd`` ticks) trace the legacy body exactly
       (``jax.vjp`` over (params, x) per tick, dchunk accumulated at
       the backward tick) — bitwise the legacy executor;
-    - split programs (``bwd_input``/``bwd_weight``) trace dx-only
-      vjps at ``bwd_input`` ticks (the incoming cotangent — loss grad
-      at the last virtual stage — is written into the gradient stash
-      for the later re-read) and params-only vjps at ``bwd_weight``
-      ticks (forward rematerialized from the still-stashed
-      activation), accumulating each stage's dW in microbatch order —
-      bitwise the fused step, per the module docstring.
+    - split programs (``bwd_input``/``bwd_weight``) run the TWO
+      PHASES of one fused backward trace
+      (:func:`tpu_p2p.models.zb_split.split_backward`): phase1 at the
+      ``bwd_input`` tick (remat + loss grad + dx — the critical path)
+      writes the phase boundary (per-layer cotangents and the
+      activations each dW needs) into the interval-colored boundary
+      stash; phase2 at the ``bwd_weight`` tick replays only the dW
+      GEMM contractions against that stash — no second remat, no
+      second vjp chain. The two phases partition the fused equation
+      list, and each stage accumulates dW in microbatch order, so the
+      step is bitwise the fused executor's (module docstring).
 
     Under ``lowered.lowering == "switch"`` the tick body dispatches
     through ONE ``lax.switch`` over the program's compact op table
@@ -922,6 +960,39 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
             params,
         )
 
+    def to_all_vma(z):
+        """pcast ``z`` varying over whichever of ``all_axes`` it is
+        not already varying on — boundary values mix param-derived
+        (pp-varying) and data-derived (fully varying) leaves, and the
+        stash they land in is typed over all axes."""
+        have = getattr(getattr(z, "aval", None), "vma", frozenset())
+        need = tuple(a for a in all_axes if a not in have)
+        return jax.lax.pcast(z, need, to="varying") if need else z
+
+    # True ZB-H1 split: trace the fused backward ONCE on this trace's
+    # example operands and partition it into the bwd_input phase
+    # (remat + dx) and the bwd_weight phase (dW GEMMs only) — see
+    # tpu_p2p/models/zb_split.py. Built at trace time, outside the
+    # scan, so the scan body only replays the partitioned equations.
+    sb = None
+    bnd_stash0 = ()
+    if split:
+        from tpu_p2p.models.zb_split import split_backward
+
+        sb = split_backward(
+            block_fn, loss_grad_fn,
+            chunk_of(params_local, jnp.int32(0)), zero_mb,
+            jax.lax.dynamic_index_in_dim(target_mb, 0, 0,
+                                         keepdims=False),
+            varying(jnp.zeros(mb_shape, jnp.float32)),
+            my == n - 1,
+        )
+        bnd_stash0 = tuple(
+            varying(jnp.zeros((lowered.bnd_slots,) + a.shape,
+                              a.dtype))
+            for a in sb.boundary_avals
+        )
+
     def stash_recv(x_stash, g_stash, y_recv, g_recv, row):
         """Write the tick's arrivals into their stash slots — shared
         verbatim by BOTH lowerings (receives are mask-gated in each:
@@ -956,13 +1027,15 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
         )
 
     def tick(carry, row):
-        x_stash, g_stash, y_recv, g_recv, dparams, loss_acc = carry
+        (x_stash, g_stash, bnd_stash, y_recv, g_recv, dparams,
+         loss_acc) = carry
         x_stash, g_stash = stash_recv(x_stash, g_stash, y_recv,
                                       g_recv, row)
 
         # Backward (fused) / backward-input (split): remat the chunk's
-        # forward under vjp — against both (params, x) when fused,
-        # against x alone when split (dW has its own tick).
+        # forward under vjp. The split runs phase1 of the partitioned
+        # fused trace — the same remat + loss-grad + dx equations —
+        # and parks the phase boundary for the deferred dW tick.
         b_mb = pick(row["b_mb"])
         b_on = b_mb >= 0
         b_cidx = pick(row["b_cidx"])
@@ -972,42 +1045,38 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
             0, keepdims=False,
         )
         chunk_b = chunk_of(params_local, b_cidx)
-        if split:
-            y_re, vjp_x = jax.vjp(lambda xx: block_fn(chunk_b, xx),
-                                  x_saved)
-        else:
-            y_re, vjp = jax.vjp(block_fn, chunk_b, x_saved)
         tgt = jax.lax.dynamic_index_in_dim(
             target_mb, jnp.clip(b_mb, 0, m - 1), 0, keepdims=False,
         )
-        loss_mb, g_loss = loss_grad_fn(y_re, tgt)
         b_gslot = jnp.clip(pick(row["b_gslot"]), 0,
                            lowered.grad_slots - 1)
         g_mid = jax.lax.dynamic_index_in_dim(g_stash, b_gslot, 0,
                                              keepdims=False)
         is_last = (my == n - 1) & (b_cidx == v - 1)
-        g_in = jnp.where(is_last, g_loss, g_mid)
-        if split:
-            # Stash the cotangent actually consumed, so the deferred
-            # bwd_weight tick reads it back: a rewrite-in-place for
-            # mid-pipeline stages (g_in == g_mid there, bitwise) and
-            # the loss gradient's only store for the last stage.
-            g_stash = jnp.where(
-                b_on,
-                jax.lax.dynamic_update_index_in_dim(
-                    g_stash, g_in.astype(jnp.float32), b_gslot, 0
-                ),
-                g_stash,
-            )
-            (dx,) = vjp_x(g_in.astype(y_re.dtype))
-        else:
-            dchunk, dx = vjp(g_in.astype(y_re.dtype))
         b_start = jnp.clip(b_cidx, 0, v - 1) * chunk_rows
 
         def accum_at(acc, dc, start, on):
             return jnp.where(on, accum_slice(acc, dc, start), acc)
 
-        if not split:
+        if split:
+            loss_mb, dx, bnd_vals = sb.phase1(chunk_b, x_saved, tgt,
+                                              g_mid, is_last)
+            b_bnd = jnp.clip(pick(row["b_bnd"]), 0,
+                             lowered.bnd_slots - 1)
+            bnd_stash = tuple(
+                jnp.where(
+                    b_on,
+                    jax.lax.dynamic_update_index_in_dim(
+                        st, to_all_vma(val), b_bnd, 0),
+                    st,
+                )
+                for st, val in zip(bnd_stash, bnd_vals)
+            )
+        else:
+            y_re, vjp = jax.vjp(block_fn, chunk_b, x_saved)
+            loss_mb, g_loss = loss_grad_fn(y_re, tgt)
+            g_in = jnp.where(is_last, g_loss, g_mid)
+            dchunk, dx = vjp(g_in.astype(y_re.dtype))
             dparams = jax.tree.map(
                 lambda acc, dc: accum_at(acc, dc, b_start, b_on),
                 dparams, dchunk,
@@ -1018,29 +1087,21 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
         dx = jnp.where(b_on, dx.astype(jnp.float32), 0.0)
 
         if split:
-            # Backward-weight: remat the forward from the still-
-            # stashed activation, vjp against the params chunk alone,
-            # cotangent re-read from the gradient stash — the same
-            # arithmetic the fused vjp runs for dW, on a later tick.
+            # Backward-weight: phase2 — the dW GEMM contractions
+            # alone, replayed against the boundary stashed at this
+            # microbatch's bwd_input tick. No remat, no vjp chain.
             w_mb = pick(row["w_mb"])
             w_on = w_mb >= 0
             w_cidx = pick(row["w_cidx"])
-            x_w = jax.lax.dynamic_index_in_dim(
-                x_stash,
-                jnp.clip(pick(row["w_slot"]), 0,
-                         lowered.act_slots - 1),
-                0, keepdims=False,
-            )
-            g_w = jax.lax.dynamic_index_in_dim(
-                g_stash,
-                jnp.clip(pick(row["w_gslot"]), 0,
-                         lowered.grad_slots - 1),
-                0, keepdims=False,
+            w_bnd = jnp.clip(pick(row["w_bnd"]), 0,
+                             lowered.bnd_slots - 1)
+            bnd_read = tuple(
+                jax.lax.dynamic_index_in_dim(st, w_bnd, 0,
+                                             keepdims=False)
+                for st in bnd_stash
             )
             chunk_w = chunk_of(params_local, w_cidx)
-            y_w, vjp_p = jax.vjp(lambda pp: block_fn(pp, x_w),
-                                 chunk_w)
-            (dchunk_w,) = vjp_p(g_w.astype(y_w.dtype))
+            dchunk_w = sb.phase2(chunk_w, bnd_read)
             w_start = jnp.clip(w_cidx, 0, v - 1) * chunk_rows
             dparams = jax.tree.map(
                 lambda acc, dc: accum_at(acc, dc, w_start, w_on),
@@ -1069,13 +1130,27 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
         y_f = jnp.where(f_on, y_f, zero_mb)
 
         if n > 1:
-            y_next = _ship(y_f, axis, lowered.fwd_edges, wave,
-                           pp_chunks, transport, label="pp_fwd_ship")
-            g_next = _ship(dx, axis, lowered.bwd_edges, wave,
-                           pp_chunks, transport, label="pp_bwd_ship")
+            # Hop elision (see lower()): the whole mesh agrees on the
+            # per-tick ship flags, so the skipped collective is a
+            # mesh-uniform branch — never a rank-divergent one — and
+            # an elided hop's payload is read by no receive table.
+            y_next = jax.lax.cond(
+                row["ship_y"] > 0,
+                lambda: _ship(y_f, axis, lowered.fwd_edges, wave,
+                              pp_chunks, transport,
+                              label="pp_fwd_ship"),
+                lambda: y_f,
+            )
+            g_next = jax.lax.cond(
+                row["ship_g"] > 0,
+                lambda: _ship(dx, axis, lowered.bwd_edges, wave,
+                              pp_chunks, transport,
+                              label="pp_bwd_ship"),
+                lambda: dx,
+            )
         else:
             y_next, g_next = y_f, dx
-        return (x_stash, g_stash, y_next, g_next, dparams,
+        return (x_stash, g_stash, bnd_stash, y_next, g_next, dparams,
                 loss_acc), None
 
     # Cost-proportional tick: ONE lax.switch over the program's
@@ -1088,7 +1163,8 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
     zero_g = varying(jnp.zeros(mb_shape, jnp.float32))
 
     def tick_switch(carry, row):
-        x_stash, g_stash, y_recv, g_recv, dparams, loss_acc = carry
+        (x_stash, g_stash, bnd_stash, y_recv, g_recv, dparams,
+         loss_acc) = carry
         x_stash, g_stash = stash_recv(x_stash, g_stash, y_recv,
                                       g_recv, row)
 
@@ -1117,10 +1193,10 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
             return (b_cidx, x_saved, chunk_b, tgt, b_gslot, g_mid,
                     is_last)
 
-        def br_noop(x_s, g_s, dp, la):
-            return x_s, g_s, dp, la, zero_mb, zero_g
+        def br_noop(x_s, g_s, bnd_s, dp, la):
+            return x_s, g_s, bnd_s, dp, la, zero_mb, zero_g
 
-        def br_fwd(x_s, g_s, dp, la):
+        def br_fwd(x_s, g_s, bnd_s, dp, la):
             f_mb = pick(row["f_mb"])
             f_cidx = pick(row["f_cidx"])
             f_slot = jnp.clip(pick(row["f_slot"]), 0,
@@ -1134,9 +1210,9 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
             x_s = jax.lax.dynamic_update_index_in_dim(x_s, x_in,
                                                       f_slot, 0)
             y_f = block_fn(chunk_of(params_local, f_cidx), x_in)
-            return x_s, g_s, dp, la, y_f, zero_g
+            return x_s, g_s, bnd_s, dp, la, y_f, zero_g
 
-        def br_bwd(x_s, g_s, dp, la):
+        def br_bwd(x_s, g_s, bnd_s, dp, la):
             (b_cidx, x_saved, chunk_b, tgt, _b_gslot, g_mid,
              is_last) = bwd_front(x_s, g_s)
             y_re, vjp = jax.vjp(block_fn, chunk_b, x_saved)
@@ -1150,75 +1226,85 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
             )
             la = la + jnp.where(is_last, loss_mb.astype(jnp.float32),
                                 0.0)
-            return x_s, g_s, dp, la, zero_mb, dx.astype(jnp.float32)
+            return (x_s, g_s, bnd_s, dp, la, zero_mb,
+                    dx.astype(jnp.float32))
 
-        def br_bwd_input(x_s, g_s, dp, la):
-            (_b_cidx, x_saved, chunk_b, tgt, b_gslot, g_mid,
+        def br_bwd_input(x_s, g_s, bnd_s, dp, la):
+            (_b_cidx, x_saved, chunk_b, tgt, _b_gslot, g_mid,
              is_last) = bwd_front(x_s, g_s)
-            y_re, vjp_x = jax.vjp(lambda xx: block_fn(chunk_b, xx),
-                                  x_saved)
-            loss_mb, g_loss = loss_grad_fn(y_re, tgt)
-            g_in = jnp.where(is_last, g_loss, g_mid)
-            # Stash the cotangent actually consumed for the deferred
-            # bwd_weight re-read (masked twin: the b_on'd rewrite).
-            g_s = jax.lax.dynamic_update_index_in_dim(
-                g_s, g_in.astype(jnp.float32), b_gslot, 0
+            # Phase1 of the partitioned fused backward (masked twin:
+            # the b_on'd phase1 + boundary-stash write).
+            loss_mb, dx, bnd_vals = sb.phase1(chunk_b, x_saved, tgt,
+                                              g_mid, is_last)
+            b_bnd = jnp.clip(pick(row["b_bnd"]), 0,
+                             lowered.bnd_slots - 1)
+            bnd_s = tuple(
+                jax.lax.dynamic_update_index_in_dim(
+                    st, to_all_vma(val), b_bnd, 0)
+                for st, val in zip(bnd_s, bnd_vals)
             )
-            (dx,) = vjp_x(g_in.astype(y_re.dtype))
             la = la + jnp.where(is_last, loss_mb.astype(jnp.float32),
                                 0.0)
-            return x_s, g_s, dp, la, zero_mb, dx.astype(jnp.float32)
+            return (x_s, g_s, bnd_s, dp, la, zero_mb,
+                    dx.astype(jnp.float32))
 
-        def br_bwd_weight(x_s, g_s, dp, la):
+        def br_bwd_weight(x_s, g_s, bnd_s, dp, la):
             w_cidx = pick(row["w_cidx"])
-            x_w = jax.lax.dynamic_index_in_dim(
-                x_s,
-                jnp.clip(pick(row["w_slot"]), 0,
-                         lowered.act_slots - 1),
-                0, keepdims=False,
-            )
-            g_w = jax.lax.dynamic_index_in_dim(
-                g_s,
-                jnp.clip(pick(row["w_gslot"]), 0,
-                         lowered.grad_slots - 1),
-                0, keepdims=False,
+            w_bnd = jnp.clip(pick(row["w_bnd"]), 0,
+                             lowered.bnd_slots - 1)
+            bnd_read = tuple(
+                jax.lax.dynamic_index_in_dim(st, w_bnd, 0,
+                                             keepdims=False)
+                for st in bnd_s
             )
             chunk_w = chunk_of(params_local, w_cidx)
-            y_w, vjp_p = jax.vjp(lambda pp: block_fn(pp, x_w),
-                                 chunk_w)
-            (dchunk_w,) = vjp_p(g_w.astype(y_w.dtype))
+            dchunk_w = sb.phase2(chunk_w, bnd_read)
             w_start = jnp.clip(w_cidx, 0, v - 1) * chunk_rows
             dp = jax.tree.map(
                 lambda acc, dc: accum_slice(acc, dc, w_start),
                 dp, dchunk_w,
             )
-            return x_s, g_s, dp, la, zero_mb, zero_g
+            return x_s, g_s, bnd_s, dp, la, zero_mb, zero_g
 
         branch_of = {"noop": br_noop, "fwd": br_fwd, "bwd": br_bwd,
                      "bwd_input": br_bwd_input,
                      "bwd_weight": br_bwd_weight}
         code = pick(row["op_code"])
-        (x_stash, g_stash, dparams, loss_acc, y_f, dx) = \
+        (x_stash, g_stash, bnd_stash, dparams, loss_acc, y_f, dx) = \
             jax.lax.switch(
                 code, [branch_of[k] for k in lowered.op_table],
-                x_stash, g_stash, dparams, loss_acc,
+                x_stash, g_stash, bnd_stash, dparams, loss_acc,
             )
 
         if n > 1:
-            y_next = _ship(y_f, axis, lowered.fwd_edges, wave,
-                           pp_chunks, transport, label="pp_fwd_ship")
-            g_next = _ship(dx, axis, lowered.bwd_edges, wave,
-                           pp_chunks, transport, label="pp_bwd_ship")
+            # Hop elision (see lower()): the whole mesh agrees on the
+            # per-tick ship flags, so the skipped collective is a
+            # mesh-uniform branch — never a rank-divergent one — and
+            # an elided hop's payload is read by no receive table.
+            y_next = jax.lax.cond(
+                row["ship_y"] > 0,
+                lambda: _ship(y_f, axis, lowered.fwd_edges, wave,
+                              pp_chunks, transport,
+                              label="pp_fwd_ship"),
+                lambda: y_f,
+            )
+            g_next = jax.lax.cond(
+                row["ship_g"] > 0,
+                lambda: _ship(dx, axis, lowered.bwd_edges, wave,
+                              pp_chunks, transport,
+                              label="pp_bwd_ship"),
+                lambda: dx,
+            )
         else:
             y_next, g_next = y_f, dx
-        return (x_stash, g_stash, y_next, g_next, dparams,
+        return (x_stash, g_stash, bnd_stash, y_next, g_next, dparams,
                 loss_acc), None
 
-    carry0 = (x_stash0, g_stash0, zero_mb,
+    carry0 = (x_stash0, g_stash0, bnd_stash0, zero_mb,
               varying(jnp.zeros(mb_shape, jnp.float32)), dparams0,
               varying(jnp.zeros((), jnp.float32)))
     rows = {k: jnp.asarray(v) for k, v in lowered.tables.items()}
-    (_, _, _, _, dparams, loss_acc), _ = jax.lax.scan(
+    (_, _, _, _, _, dparams, loss_acc), _ = jax.lax.scan(
         tick_switch if lowered.lowering == "switch" else tick,
         carry0, rows,
     )

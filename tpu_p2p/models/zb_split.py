@@ -1,0 +1,212 @@
+"""True ZB-H1 backward split: one fused-backward trace, two phases.
+
+The zero-bubble schedule (Qi et al., arXiv:2401.10241) only pays off
+when ``bwd_weight`` is genuinely cheaper than a fused backward — dW as
+plain per-layer GEMM contractions against stashed operands, not a
+second rematerialized ``jax.vjp`` chain. Rounds 14–16 ran the split
+schedule with TWO independent vjps (dx-only at ``bwd_input``, a full
+remat + params-only vjp at ``bwd_weight``), which re-bought the
+forward twice and left zb measurably *behind* fused 1F1B on the 8-dev
+mesh (67 ms fused vs 92 ms zb — docs/schedule_ir.md round 16).
+
+This module removes that tax at the jaxpr level. ``split_backward``
+traces the executor's EXACT fused backward body once —
+
+    y, vjp = jax.vjp(block_fn, chunk, x)
+    loss_mb, g_loss = loss_grad_fn(y, tgt)
+    g_in = jnp.where(is_last, g_loss, g_mid)
+    dchunk, dx = vjp(g_in.astype(y.dtype))
+
+— and partitions its equations by reverse reachability (dead-code
+cones):
+
+- **phase1** = every equation in the cone of ``(loss_mb, dx)``: the
+  forward remat, the loss gradient, and the dx chain — the
+  inter-stage critical path, run at the ``bwd_input`` tick;
+- **phase2** = the remaining equations in the cone of ``dchunk``: the
+  per-layer dW contractions alone, run at the deferred ``bwd_weight``
+  tick;
+- **boundary** = the values phase2 consumes but does not compute (the
+  stashed per-layer cotangents and the activations each dW
+  contraction reads — x itself included), in deterministic
+  first-definition order. The executor stashes exactly these between
+  the two ticks (:class:`~tpu_p2p.models.schedule.LoweredProgram`
+  interval-colors the slots).
+
+Because phase1 + phase2 is a *partition* of the fused equation list —
+same primitives, same operands, same relative order, replayed via
+``eqn.primitive.bind`` — the split step executes the fused step's
+arithmetic exactly once, and per-stage dW accumulation in microbatch
+order keeps gradients bitwise the fused executor's
+(tests/test_schedule.py pins both). ``bwd_weight``'s cost drops below
+a forward's (:data:`~tpu_p2p.models.schedule.OP_COST`), which is the
+whole zero-bubble claim.
+
+Degenerate case: under ``jax.checkpoint``-wrapped blocks the backward
+is ONE opaque remat equation producing dx and dchunk together, so the
+partition places it (correctly) in phase1, the dchunk leaves travel
+the boundary, and phase2 is a passthrough — still bitwise, no longer
+cheaper. Leave remat off on zb runs; the scheduler prices the split
+assuming real GEMM-only phase2 ticks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:  # jax >= 0.4.34 exports the IR types via jax.extend
+    from jax.extend.core import Literal, Var
+except ImportError:  # pragma: no cover — older containers
+    from jax.core import Literal, Var
+
+
+def _read(env: Dict[Any, Any], atom):
+    if isinstance(atom, Literal):
+        return atom.val
+    return env[atom]
+
+
+def _eval_eqns(eqns: Sequence[Any], env: Dict[Any, Any]) -> None:
+    """Replay jaxpr equations in order via ``primitive.bind`` — the
+    same primitives with the same params the original trace recorded,
+    so the replay lowers to the same XLA ops (the bitwise lever)."""
+    for eqn in eqns:
+        invals = [_read(env, v) for v in eqn.invars]
+        ans = eqn.primitive.bind(*invals, **eqn.params)
+        if not eqn.primitive.multiple_results:
+            ans = [ans]
+        for var, val in zip(eqn.outvars, ans):
+            env[var] = val
+
+
+@dataclass(frozen=True)
+class SplitBackward:
+    """The two executable phases of one fused backward trace.
+
+    ``phase1(chunk, x, tgt, g_mid, is_last) -> (loss_mb, dx,
+    boundary)`` runs the critical path; ``phase2(chunk, boundary) ->
+    dchunk`` runs the deferred dW contractions against the stashed
+    boundary values. ``boundary_avals`` gives each boundary leaf's
+    shape/dtype so the executor can size the interval-colored stash.
+    """
+
+    phase1: Callable
+    phase2: Callable
+    boundary_avals: Tuple[jax.ShapeDtypeStruct, ...]
+    num_phase2_eqns: int
+
+
+def split_backward(block_fn: Callable, loss_grad_fn: Callable,
+                   chunk_example, x_example, tgt_example,
+                   g_mid_example, is_last_example) -> SplitBackward:
+    """Trace the fused backward once and partition it (module doc).
+
+    Example arguments may be tracers (the executor builds the split
+    inside its ``shard_map`` trace, so stash/axis typing carries
+    through) — only shapes and dtypes are read here.
+    """
+    chunk_leaves, chunk_treedef = jax.tree.flatten(chunk_example)
+    n_param = len(chunk_leaves)
+
+    def fused(chunk, x, tgt, g_mid, is_last):
+        y, vjp = jax.vjp(block_fn, chunk, x)
+        loss_mb, g_loss = loss_grad_fn(y, tgt)
+        g_in = jnp.where(is_last, g_loss, g_mid)
+        dchunk, dx = vjp(g_in.astype(y.dtype))
+        return loss_mb, dx, dchunk
+
+    closed = jax.make_jaxpr(fused)(chunk_example, x_example,
+                                   tgt_example, g_mid_example,
+                                   is_last_example)
+    jaxpr, consts = closed.jaxpr, closed.consts
+    outvars = jaxpr.outvars
+    p1_out, p2_out = outvars[:2], outvars[2:]
+    if len(p2_out) != n_param:
+        raise ValueError(
+            f"fused backward returned {len(p2_out)} dchunk leaves for "
+            f"{n_param} param leaves — block_fn must be a pytree-"
+            "preserving function of its params chunk"
+        )
+
+    # phase1 = the full reverse-reachability cone of (loss, dx).
+    needed1 = {v for v in p1_out if isinstance(v, Var)}
+    p1_eqns: List[Any] = []
+    p1_ids = set()
+    for eqn in reversed(jaxpr.eqns):
+        if any(ov in needed1 for ov in eqn.outvars):
+            p1_eqns.append(eqn)
+            p1_ids.add(id(eqn))
+            needed1.update(v for v in eqn.invars if isinstance(v, Var))
+    p1_eqns.reverse()
+
+    # phase2 = the cone of dchunk minus phase1 — the dW-only tail.
+    needed2 = {v for v in p2_out if isinstance(v, Var)}
+    p2_eqns: List[Any] = []
+    for eqn in reversed(jaxpr.eqns):
+        if id(eqn) in p1_ids:
+            continue
+        if any(ov in needed2 for ov in eqn.outvars):
+            p2_eqns.append(eqn)
+            needed2.update(v for v in eqn.invars if isinstance(v, Var))
+    p2_eqns.reverse()
+
+    # Boundary = what phase2 reads but neither computes nor gets
+    # re-supplied at the bwd_weight tick (params are re-sliced there;
+    # consts close over both phases). Ordered by first definition —
+    # invars, then equation outputs in program order — so the stash
+    # layout is deterministic.
+    p2_produced = {ov for eqn in p2_eqns for ov in eqn.outvars}
+    param_invars = set(jaxpr.invars[:n_param])
+    const_vars = set(jaxpr.constvars)
+    boundary: List[Var] = []
+    seen = set()
+    for v in list(jaxpr.invars) + [ov for eqn in jaxpr.eqns
+                                   for ov in eqn.outvars]:
+        if (v in needed2 and v not in p2_produced
+                and v not in param_invars and v not in const_vars
+                and v not in seen):
+            boundary.append(v)
+            seen.add(v)
+
+    const_env = dict(zip(jaxpr.constvars, consts))
+    in_treedef = jax.tree.structure(
+        (chunk_example, x_example, tgt_example, g_mid_example,
+         is_last_example))
+
+    def phase1(chunk, x, tgt, g_mid, is_last):
+        flat_args, td = jax.tree.flatten((chunk, x, tgt, g_mid,
+                                          is_last))
+        if td != in_treedef:
+            raise ValueError(
+                f"phase1 args tree {td} != traced tree {in_treedef}")
+        env = dict(const_env)
+        env.update(zip(jaxpr.invars, flat_args))
+        _eval_eqns(p1_eqns, env)
+        loss_mb = _read(env, p1_out[0])
+        dx = _read(env, p1_out[1])
+        return loss_mb, dx, tuple(_read(env, v) for v in boundary)
+
+    def phase2(chunk, boundary_vals):
+        leaves = jax.tree.leaves(chunk)
+        if len(leaves) != n_param:
+            raise ValueError(
+                f"phase2 got {len(leaves)} param leaves; traced "
+                f"{n_param}")
+        env = dict(const_env)
+        env.update(zip(jaxpr.invars[:n_param], leaves))
+        env.update(zip(boundary, boundary_vals))
+        _eval_eqns(p2_eqns, env)
+        return jax.tree.unflatten(
+            chunk_treedef, [_read(env, v) for v in p2_out])
+
+    return SplitBackward(
+        phase1=phase1, phase2=phase2,
+        boundary_avals=tuple(
+            jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
+            for v in boundary),
+        num_phase2_eqns=len(p2_eqns),
+    )

@@ -122,6 +122,14 @@ TOLERANCES: Dict[str, Tolerance] = {
     # sentinel) — the checkpoint-durability pair took their bytes
     # (bench.py HEADLINE_KEYS note; test_round17_budget_trade).
     "pp_step_ms_sched_zb": Tolerance("lower", 0.25),
+    # Round 17 (ZB-H1 weight split): the dimensionless zb/fused
+    # wall-clock ratio. Gated ALONGSIDE the absolute zb step time so
+    # a machine-wide slowdown (both arms drift together, ratio
+    # steady) does not page while a shift in the zb-vs-fused
+    # relationship (split regression, elision loss) does. NULL with
+    # the reason in sched_error on 1-device meshes, where compile_zb
+    # degrades to the fused schedule.
+    "pp_zb_vs_fused_ratio": Tolerance("lower", 0.25),
     # PR 3 obs keys (bench.py _obs_metrics).
     "obs_step_ms_p50": Tolerance("lower", 0.30),
     # PR 6 dma-transport keys (bench.py _dma_transport_metrics): the
@@ -563,11 +571,12 @@ def print_schedule_bubbles(n: int, cur_head: Optional[dict] = None,
     ``pp_step_ms_sched_{1f1b,zb}`` pair from the gated bench
     artifact when it carries one — reported with its arms NAMED (the
     zb route under the switch lowering vs the fused production step
-    under its masked legacy executor, at bench's own shape), because
-    the pair deliberately compares the shipped routes, not the
-    schedules under one lowering — so it is context next to the
-    analytic ratio, not its executed twin (docs/schedule_ir.md,
-    "what the bench pair grades").
+    under its masked tick-IR lowering, at bench's own shape), plus
+    the gated ``pp_zb_vs_fused_ratio`` — because the pair
+    deliberately compares the shipped routes, not the schedules
+    under one lowering — so it is context next to the analytic
+    ratio, not its executed twin (docs/schedule_ir.md, "what the
+    bench pair grades").
     """
     out = stream if stream is not None else sys.stdout
     from tpu_p2p.models import schedule as SCH
@@ -600,9 +609,12 @@ def print_schedule_bubbles(n: int, cur_head: Optional[dict] = None,
     ms_1 = head.get("pp_step_ms_sched_1f1b")
     ms_z = head.get("pp_step_ms_sched_zb")
     if ms_1 and ms_z:
+        r_m = head.get("pp_zb_vs_fused_ratio")
+        suffix = f" (ratio {r_m})" if r_m is not None else ""
         out.write(
             f"#   measured bench pair: zb route (switch lowering) "
-            f"{ms_z} ms vs fused production step (masked) {ms_1} ms\n"
+            f"{ms_z} ms vs fused production step (masked) {ms_1} ms"
+            f"{suffix}\n"
         )
     else:
         out.write(

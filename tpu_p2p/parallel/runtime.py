@@ -260,11 +260,41 @@ def make_hybrid_runtime(num_devices: Optional[int] = None,
                    torus=topology.torus_from_devices(flat))
 
 
+def _ring_ordered(devices, ring_topology) -> Tuple:
+    """Permute a 1D device world by the measured-topology ring order.
+
+    ``ring_topology`` is a :class:`tpu_p2p.topo.model.Topology` (or
+    None to read the ``MULTICHIP_r*.json`` harvest history in the
+    CWD). A pure relabeling of which physical device backs which
+    logical rank — the program and every computed value are unchanged
+    (the bitwise pin tests/test_runtime.py holds) — but the logical
+    shift-by-1 ring now rides the links the link matrix recommends.
+    Returns ``devices`` untouched when no usable topology exists or
+    its size disagrees with the world."""
+    try:
+        from tpu_p2p.topo.model import Topology
+        from tpu_p2p.topo.place import ordered_devices, ring_order
+
+        topo = ring_topology
+        if topo is None:
+            topo = Topology.from_history(".", n=len(devices))
+        if topo is None or topo.n != len(devices):
+            return tuple(devices)
+        return tuple(ordered_devices(list(devices), ring_order(topo)))
+    except Exception:
+        # Placement advice must never break bootstrap (missing/corrupt
+        # harvest files, probe-only worlds): fall back to enumeration
+        # order.
+        return tuple(devices)
+
+
 def make_runtime(
     num_devices: Optional[int] = None,
     mesh_shape: Optional[Tuple[int, ...]] = None,
     axis_names: Optional[Tuple[str, ...]] = None,
     devices=None,
+    ring_topology=None,
+    apply_ring_order: bool = True,
 ) -> Runtime:
     """Bootstrap → validate placement → build the mesh.
 
@@ -276,6 +306,16 @@ def make_runtime(
     ``mesh_shape``/``axis_names`` default to a 1D mesh ``("d",)`` over
     all devices; pass e.g. ``(4, 2), ("x", "y")`` for the 2D-torus
     workload (BASELINE.json configs[4]).
+
+    1D default meshes pick up the measured link matrix's recommended
+    ring order (``topo.place.ring_order`` over the harvest history —
+    the ROADMAP fleet-serving follow-up): a pure device relabeling,
+    bitwise-invisible to the program, that puts the shift-by-1 ring on
+    the fastest physical cycle. Pass ``ring_topology`` to inject a
+    topology explicitly, or ``apply_ring_order=False`` to keep raw
+    enumeration order; explicit ``mesh_shape`` worlds are left alone
+    (a 2D torus's axes encode physical structure the ring objective
+    would scramble).
     """
     init_distributed()
     if devices is None:
@@ -287,6 +327,8 @@ def make_runtime(
         )
         devices = devices[:num_devices]
     devices = tuple(devices)
+    if apply_ring_order and mesh_shape is None and len(devices) > 2:
+        devices = _ring_ordered(devices, ring_topology)
     placement = topology.placement_from_devices(devices)
     torus = topology.torus_from_devices(devices)
     if mesh_shape is None:
