@@ -17,7 +17,7 @@ SHELL    := /bin/bash
 
 NATIVE_SO := native/libtpu_p2p_native.so
 
-.PHONY: all native run test tier1 bench obs topo zb health serve serve-disagg serve-chaos ckpt-chaos clean
+.PHONY: all native run test tier1 bench obs topo zb trace health serve serve-disagg serve-chaos ckpt-chaos clean
 
 all: native
 
@@ -73,6 +73,17 @@ topo:
 # runs anywhere; override with ARGS= on real hardware.
 zb:
 	$(PYTHON) -m tpu_p2p zb $(if $(ARGS),$(ARGS),--cpu-mesh 8)
+
+# Tick flight recorder smoke (docs/tracing.md): measured per-(rank,
+# tick) timelines joined to the compiled Tick IR + the Chrome-trace
+# export — nonzero exit unless the measured zb per-rank bubble
+# ordering matches the analytic per_rank_idle ordering (idle-tick
+# placement graded under the switch lowering), the per-tick constant-
+# overhead estimate is nonzero, and the export schema-validates.
+# Defaults to the simulated 8-device CPU mesh so it runs anywhere;
+# override with ARGS= on real hardware.
+trace:
+	$(PYTHON) -m tpu_p2p obs trace $(if $(ARGS),$(ARGS),--cpu-mesh 8)
 
 # Injected-fault health smoke (docs/health.md): degraded link,
 # straggler rank, and lost host + self-healing resume, each detected

@@ -77,11 +77,10 @@ HEADLINE_KEYS = (
     "ep_step_ms_overlap_ring",
     "pp_overlap_frac",
     "pp_step_ms_overlap_wave",
-    "pp_step_ms_sched_zb",
     "pp_zb_vs_fused_ratio",
+    "pp_bubble_frac_measured_zb",
     "obs_step_ms_p50",
     "health_detect_steps",
-    "p2p_lat_us_pallas",
     "ring_gbps_pallas",
     "serve_tokens_per_s",
     "serve_tok_ms_p99",
@@ -191,6 +190,25 @@ HEADLINE_KEYS = (
     # from round 18). All three still measure into BENCH_detail.json;
     # their tolerances retired per the tolerance-⊆-headline rule.
     # test_round19_budget_trade pins the move.
+    # Round 20 applied the same rule to two more to make room for the
+    # flight-recorder key pp_bubble_frac_measured_zb (the MEASURED
+    # per-rank mean bubble of the zb tick program on the pure-pp
+    # mesh, host-stamped per tick and joined to the Tick IR —
+    # tpu_p2p/obs/tickprof.py, docs/tracing.md): pp_step_ms_sched_zb
+    # (the zb arm's absolute wall clock — its RATIO twin
+    # pp_zb_vs_fused_ratio grades the same zb-vs-fused claim
+    # box-speed-independently, the exact reason the ratio was added
+    # in round 17, and the absolute number still measures into
+    # BENCH_detail.json; the serve_tokens_per_s_static
+    # "the graded claim lives in the comparison, not the absolute"
+    # precedent from round 14) and p2p_lat_us_pallas (the pallas
+    # latency arm — latency_8b_p50_us already grades the same
+    # dispatch-floor family, the EXACT argument that retired its XLA
+    # twin in round 17, and ring_gbps_pallas stays as the
+    # pallas-transport sentinel). Both still measure into
+    # BENCH_detail.json; their tolerances retired per the
+    # tolerance-⊆-headline rule. test_round20_budget_trade pins the
+    # move.
 )
 
 
@@ -1270,6 +1288,67 @@ def _check_sched_losses(loss_1f1b, loss_zb):
             f"pp_schedule loss divergence: 1f1b={loss_1f1b} "
             f"zb={loss_zb}"
         )
+
+
+# Null shape of _trace_metrics — failure (or the 1-chip degenerate
+# mesh, where compile_zb collapses to the fused schedule and a
+# "measured bubble" would grade the degenerate program) must produce
+# the same keys, with trace_error naming WHY (schema stability,
+# mirroring SCHED_NULL / TOPO_NULL).
+TRACE_NULL = {
+    "trace_devices": None,
+    # The round-20 flight-recorder headline: mean over ranks of the
+    # MEASURED per-rank bubble fraction of the zb tick program on
+    # the pure-pp mesh — host tick-boundary stamps joined to the
+    # Tick IR (tpu_p2p/obs/tickprof.py, docs/tracing.md), the
+    # measured twin of the analytic pp_bubble_frac_zb constant.
+    "pp_bubble_frac_measured_zb": None,
+    # Diagnostic companions (detail-only, never gated): the per-tick
+    # constant overhead the decomposition isolates — the residual
+    # the analytic model cannot see (ROADMAP PR 17) — and how it was
+    # estimated ("fit intercept" or "min-tick floor").
+    "trace_constant_overhead_ms": None,
+    "trace_overhead_source": None,
+    "trace_error": None,
+}
+
+
+def _trace_metrics(timing):
+    """Tick flight recorder (round-20 tentpole —
+    tpu_p2p/obs/tickprof.py): run the zb program under the
+    cost-proportional switch lowering with the per-tick host stamps
+    on, and publish the measured per-rank mean bubble fraction next
+    to the analytic constant the schedule IR already grades. NULL
+    with the reason on a 1-chip mesh (compile_zb degrades to the
+    fused schedule there — the pp_zb_vs_fused_ratio convention)."""
+    import jax
+
+    out = dict(TRACE_NULL)
+    n = len(jax.devices())
+    out["trace_devices"] = n
+    if n < 2:
+        out["trace_error"] = (
+            "TRACE_NULL: 1-device mesh — compile_zb degrades to the "
+            "fused schedule, so a measured bubble would grade the "
+            "degenerate program (the pp_zb_vs_fused_ratio "
+            "convention)")
+        return out
+    from tpu_p2p.obs.tickprof import run_flight_recorder
+
+    rep = run_flight_recorder(n, schedule="zb",
+                              tick_lowering="switch",
+                              device_trace=False)
+    fracs = [r["bubble_frac"] for r in rep["measured"]]
+    out["pp_bubble_frac_measured_zb"] = round(
+        float(sum(fracs) / len(fracs)), 4)
+    d = rep["decomposition"]
+    if d["constant_overhead_ms"] is not None:
+        out["trace_constant_overhead_ms"] = round(
+            d["constant_overhead_ms"], 3)
+        out["trace_overhead_source"] = (
+            "fit intercept" if d["intercept_from_fit"]
+            else "min-tick floor")
+    return out
 
 
 # Null shape of _obs_metrics — failure must produce the same keys
@@ -2874,6 +2953,16 @@ def main() -> int:
               file=sys.stderr)
         sched_m = {"sched_error": f"{type(e).__name__}: {e}"}
     result["detail"].update({k: sched_m.get(k) for k in SCHED_NULL})
+    # Tick flight recorder (round-20 tentpole): measured per-rank
+    # bubble of the zb program via per-tick host stamps joined to the
+    # Tick IR, TRACE_NULL schema (with the reason) on 1-chip meshes
+    # or failure.
+    try:
+        trace_m = _trace_metrics(timing)
+    except Exception as e:  # noqa: BLE001 — same rationale
+        print(f"# trace measurement failed: {e!r}", file=sys.stderr)
+        trace_m = {"trace_error": f"{type(e).__name__}: {e}"}
+    result["detail"].update({k: trace_m.get(k) for k in TRACE_NULL})
     # Observability metrics (round-8 tentpole): ledger-joined achieved
     # collective bandwidth + timeline step cadence, both branches.
     try:

@@ -1116,8 +1116,13 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         # durability pair (test_round17_budget_trade pins the move).
         # pp_bubble_frac_zb (the remaining analytic schedule
         # constant) left in the round-19 trade for the topology pair
-        # (test_round19_budget_trade).
-        "pp_step_ms_sched_zb": 98.765,
+        # (test_round19_budget_trade); pp_step_ms_sched_zb left in
+        # the round-20 trade for the flight recorder's measured
+        # bubble — the graded zb-vs-fused claim lives in the RATIO
+        # below (test_round20_budget_trade pins the move).
+        # Round 20: the flight recorder's measured zb bubble (bench.py
+        # _trace_metrics; host tick stamps joined to the Tick IR).
+        "pp_bubble_frac_measured_zb": 0.7412,
         # Round 17 (ZB-H1 weight split): the dimensionless zb/fused
         # ratio joined the line next to its absolute twin — it nulls
         # with the reason on 1-device rounds (compile_zb degrades to
@@ -1139,9 +1144,11 @@ def test_compact_line_fits_with_every_headline_key_at_realistic_width():
         # are — never drift-quoted; the min/max_gbps precedent).
         # p2p_lat_us_xla left in the round-17 trade (note above);
         # ring_gbps_xla left in the round-19 trade for the topology
-        # pair (the same baseline-arm rule; the pallas arm stays as
-        # the dma sentinel — test_round19_budget_trade).
-        "p2p_lat_us_pallas": 98.7654,
+        # pair, and p2p_lat_us_pallas in the round-20 one
+        # (latency_8b_p50_us grades the same dispatch-floor family —
+        # the round-17 argument applied to the pallas arm; the busbw
+        # key stays as the dma sentinel — test_round19/20_budget_
+        # trade).
         "ring_gbps_pallas": 1187.43,
         # Round 13: the serve quartet joined the line;
         # flagship_large_tokens_per_s (byte-derivable from the step
@@ -1299,14 +1306,14 @@ def test_dma_headline_keys_survive_compact_budget():
     # Satellite contract (round 11): the transport head-to-head keys
     # ride the ≤1 KiB compact line at realistic widths.
     # (p2p_lat_us_xla left the line in the round-17 budget trade,
-    # ring_gbps_xla in the round-19 one — test_round17/19_budget_
-    # trade pin those moves; the pallas arms stay as the sentinels.)
-    new = ("p2p_lat_us_pallas", "ring_gbps_pallas")
+    # ring_gbps_xla in the round-19 one, p2p_lat_us_pallas in the
+    # round-20 one — test_round17/19/20_budget_trade pin those moves;
+    # the pallas busbw arm stays as the sentinel.)
+    new = ("ring_gbps_pallas",)
     for k in new:
         assert k in bench.HEADLINE_KEYS, k
     detail = {
         "devices": 256,
-        "p2p_lat_us_pallas": 98.7654,
         "ring_gbps_pallas": 1187.43,
     }
     result = {
@@ -1386,10 +1393,12 @@ def test_round14_budget_trade():
     # pp_bubble_frac_1f1b joined the line in round 14 and left it
     # again in the round-15 trade (test_round15_budget_trade);
     # pp_step_ms_sched_1f1b followed in round 17
-    # (test_round17_budget_trade), and pp_bubble_frac_zb in round 19
-    # (test_round19_budget_trade) — the measured zb arm is what
-    # remains graded of the quartet.
-    for k in ("pp_step_ms_sched_zb",):
+    # (test_round17_budget_trade), pp_bubble_frac_zb in round 19
+    # (test_round19_budget_trade), and pp_step_ms_sched_zb in round
+    # 20 (test_round20_budget_trade) — the dimensionless zb/fused
+    # ratio is what remains graded of the quartet, now joined by the
+    # flight recorder's MEASURED zb bubble.
+    for k in ("pp_zb_vs_fused_ratio",):
         assert k in bench.HEADLINE_KEYS, k
         assert k in bench.SCHED_NULL, k
         assert k in TOLERANCES, k
@@ -1508,6 +1517,77 @@ def test_round19_budget_trade():
         assert k in bench.HEADLINE_KEYS, k
         assert k in bench.TOPO_NULL, k
         assert k in TOLERANCES, k
+
+
+def test_round20_budget_trade():
+    # The round-20 budget trade, pinned like the round-13..19 ones:
+    # two keys left the compact line for the flight recorder's
+    # measured zb bubble but still measure into BENCH_detail.json.
+    # pp_step_ms_sched_zb is the absolute arm of the measured
+    # schedule pair — the graded zb-vs-fused claim lives in the
+    # dimensionless pp_zb_vs_fused_ratio riding the line beside it
+    # (the serve_tokens_per_s_static precedent from round 14: the
+    # graded claim lives in the comparison, not the absolute), and
+    # the absolute wall-clock stays in the detail artifact.
+    # p2p_lat_us_pallas is the pallas latency arm of the transport
+    # head-to-head — latency_8b_p50_us grades the same dispatch-floor
+    # family (the EXACT argument that retired its XLA twin in round
+    # 17) and ring_gbps_pallas stays as the pallas-transport
+    # sentinel. pp_bubble_frac_measured_zb is the NEW key: the
+    # flight recorder's per-rank mean measured bubble (host tick
+    # stamps joined to the Tick IR, tpu_p2p/obs/tickprof.py) —
+    # unlike the analytic constants retired in rounds 15/19 it is a
+    # measurement, so it can regress and carries a tolerance.
+    # Tolerances retired WITH the leaving keys per the gate's
+    # tolerance-⊆-headline rule.
+    from tpu_p2p.obs.regress import TOLERANCES
+
+    gone = ("pp_step_ms_sched_zb", "p2p_lat_us_pallas")
+    for k in gone:
+        assert k not in bench.HEADLINE_KEYS, k
+        assert k not in TOLERANCES, k
+    assert "pp_step_ms_sched_zb" in bench.SCHED_NULL
+    assert "p2p_lat_us_pallas" in bench.DMA_NULL
+    for k in ("pp_bubble_frac_measured_zb",):
+        assert k in bench.HEADLINE_KEYS, k
+        assert k in bench.TRACE_NULL, k
+        assert k in TOLERANCES, k
+
+
+def test_trace_metrics_null_schema_on_one_device(monkeypatch):
+    # A 1-device mesh degrades compile_zb to the fused schedule —
+    # nothing to measure; the TRACE_NULL schema must publish the
+    # reason (the disagg/topo small-mesh precedent).
+    import jax
+
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a, **k: [object()])
+    out = bench._trace_metrics(None)
+    assert set(out) == set(bench.TRACE_NULL)
+    assert out["pp_bubble_frac_measured_zb"] is None
+    assert "1-device" in out["trace_error"]
+
+
+def test_trace_metrics_populated_from_recorder(monkeypatch):
+    # The populated path: the recorder's per-rank measured fracs
+    # reduce to their mean at 4 decimals, and the constant-overhead
+    # estimate is published with its source label.
+    from tpu_p2p.obs import tickprof
+
+    monkeypatch.setattr(
+        tickprof, "run_flight_recorder",
+        lambda n, **kw: {
+            "measured": [{"device": 0, "bubble_frac": 0.7},
+                         {"device": 1, "bubble_frac": 0.8}],
+            "decomposition": {"constant_overhead_ms": 1.2345,
+                              "intercept_from_fit": False},
+        })
+    out = bench._trace_metrics(None)
+    assert out["trace_devices"] == 8
+    assert out["pp_bubble_frac_measured_zb"] == pytest.approx(0.75)
+    assert out["trace_constant_overhead_ms"] == pytest.approx(1.234)
+    assert out["trace_overhead_source"] == "min-tick floor"
+    assert out["trace_error"] is None
 
 
 # ------------------------------------------------------- topo metric

@@ -181,6 +181,19 @@ SUMMARY_PATTERNS = {
     "obs_watch": ["obs", "watch",
                   "tests/golden/obs_watch_fixture.jsonl",
                   "--expect-alerts"],
+    # The round-20 flight-recorder smoke end to end on the 8-device
+    # mesh (the `make trace` grader, docs/tracing.md): the measured
+    # per-rank table joined to the zb Tick IR, the two agreement
+    # grades, the per-kind decomposition, and the Chrome-trace export
+    # count (8 ranks × 26 ticks × 2 X events + 9 metadata rows = 425,
+    # schedule-deterministic). rc 0 asserts the smoke GRADED — the
+    # acceptance criterion rides this pin. Beyond the float masking,
+    # _mask_trace collapses the load-dependent grade tokens (graded-
+    # rank counts, the optional beneath-timer-floor ungraded clause,
+    # the fit-vs-floor overhead source, marginal-coefficient signs);
+    # the table layout, tick counts, verdict lines, and event count
+    # stay pinned.
+    "obs_trace": ["obs", "trace", "--cpu-mesh", "8"],
 }
 
 _FIELD = re.compile(r" *\d+\.\d\d")  # a whole padded %6.02f field
@@ -238,6 +251,38 @@ def mask_floats(text: str) -> str:
                             _ANY_FLOAT.sub("####", text))
 
 
+# Flight-recorder grade tokens that depend on box load, not the
+# contract: how many ranks clear the host-timer floor (and the
+# ungraded clause + reason line when some do not), whether the
+# constant-overhead fit produced a positive intercept, and the sign
+# of the collinear marginal coefficients. The masked golden pins the
+# report's layout, labels, tick counts, and the PASS verdict.
+_TRACE_GRADE = re.compile(r"\d+ of \d+ graded rank\(s\)")
+_TRACE_UNGRADED = re.compile(
+    r"; \d+ rank\(s\) ungraded \(beneath timer floor [^)]*\)")
+_TRACE_NOT_GRADED = re.compile(
+    r"^#   idle placement not graded: .*\n", re.M)
+_TRACE_SOURCE = re.compile(r"\((?:fit intercept|min-tick floor)\)")
+_TRACE_NEG = re.compile(r"-(?=#### ms per)")
+# Scheduler contention can flunk 1-2 ranks' idle-placement grade
+# (tolerated by the 2/3 quorum); the listing clause is load-dependent.
+_TRACE_FAILURES = re.compile(
+    r" — ranks \[[\d, ]*\] do not(?: \(within the 2/3 quorum\))?")
+
+
+def _mask_trace(text: str) -> str:
+    text = _TRACE_FAILURES.sub("", text)
+    text = _TRACE_UNGRADED.sub("", text)
+    text = _TRACE_NOT_GRADED.sub("", text)
+    text = _TRACE_GRADE.sub("# of # graded rank(s)", text)
+    text = _TRACE_SOURCE.sub("(overhead source)", text)
+    return _TRACE_NEG.sub("", text)
+
+
+# Per-name post-mask hooks, applied after mask_floats.
+EXTRA_MASKS = {"obs_trace": _mask_trace}
+
+
 def _run_cli(args=ARGS) -> str:
     import tempfile
 
@@ -269,6 +314,7 @@ def test_cli_matches_golden():
 @pytest.mark.parametrize("name", sorted(SUMMARY_PATTERNS))
 def test_cli_summary_matches_golden(name):
     got = mask_floats(_run_cli(SUMMARY_PATTERNS[name]))
+    got = EXTRA_MASKS.get(name, lambda t: t)(got)
     with open(_summary_golden(name)) as fh:
         want = fh.read()
     assert got == want, (
@@ -285,6 +331,8 @@ if __name__ == "__main__":
         fh.write(mask(_run_cli()))
     print(f"wrote {GOLDEN}")
     for name, args in SUMMARY_PATTERNS.items():
+        got = mask_floats(_run_cli(args))
+        got = EXTRA_MASKS.get(name, lambda t: t)(got)
         with open(_summary_golden(name), "w") as fh:
-            fh.write(mask_floats(_run_cli(args)))
+            fh.write(got)
         print(f"wrote {_summary_golden(name)}")

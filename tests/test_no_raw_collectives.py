@@ -44,6 +44,17 @@ _RAW_CALL = re.compile(
 # point), so the probe/parity traffic must ride the wrappers.
 SCANNED = ("models", "ops", "serve", "topo")
 
+# Round 20 added the flight-recorder pair file-by-file: the obs tree
+# is mostly pure host-side reduction, but tickprof.py BUILDS and runs
+# compiled tick programs (run_flight_recorder) and trace.py exports
+# their spans — a raw collective smuggled into either would ship
+# measurement traffic the ledger never prices, polluting the very
+# timeline they exist to explain.
+SCANNED_FILES = (
+    os.path.join("obs", "tickprof.py"),
+    os.path.join("obs", "trace.py"),
+)
+
 
 def _py_files():
     for sub in SCANNED:
@@ -52,6 +63,8 @@ def _py_files():
             for f in sorted(files):
                 if f.endswith(".py"):
                     yield os.path.join(dirpath, f)
+    for rel in SCANNED_FILES:
+        yield os.path.join(PKG, rel)
 
 
 def test_model_and_ops_issue_collectives_only_through_wrappers():
@@ -171,7 +184,85 @@ def test_lint_scans_the_expected_trees():
             "models/zb_split.py — extend SCANNED (and this "
             "self-test) to wherever it went"
         )
+    # Round 20: the flight-recorder pair rides the scan file-by-file
+    # (SCANNED_FILES) — tickprof.py compiles and runs tick programs,
+    # trace.py exports their spans.
+    assert "tickprof.py" in names and "trace.py" in names, \
+        sorted(names)
     assert len(files) >= 25, files
+
+
+# ------------------------------------------------- tick-time hooks
+# Round 20: the per-tick host stamps (the flight recorder's
+# measurement) are applied by exactly three helpers — _tick_stamp /
+# _tick_seed emitting jax.debug.callback(tick_times.record, ...) —
+# and those application sites live in models/schedule.py ONLY. A
+# stamp issued from anywhere else (a workload, the recorder itself)
+# would time something other than the compiled tick boundaries while
+# claiming the same (rank, tick, phase) coordinates, corrupting the
+# measured-vs-analytic join the whole subsystem grades on. The
+# recorder (obs/tickprof.py TickRecorder) DEFINES record(); it must
+# never call it on traced values.
+
+_TICK_HOOK_CALL = re.compile(
+    r"(?:\b_tick_stamp|\b_tick_seed|tick_times\.record)\s*[(,]"
+)
+
+TICK_HOOK_ALLOWED = (os.path.join("models", "schedule.py"),)
+
+
+def _tick_hook_in(line: str) -> bool:
+    # Comments stripped like the fault lint: the helper names read
+    # naturally in prose describing the hook design.
+    return bool(_TICK_HOOK_CALL.search(line.split("#", 1)[0]))
+
+
+def test_tick_hook_application_sites_live_in_schedule_only():
+    offenders = []
+    for path in _all_pkg_files():
+        rel = os.path.relpath(path, PKG)
+        if rel in TICK_HOOK_ALLOWED:
+            continue
+        with open(path) as fh:
+            for lineno, line in enumerate(fh, 1):
+                if _tick_hook_in(line):
+                    offenders.append(
+                        f"tpu_p2p/{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "tick-time stamp application outside "
+        "tpu_p2p/models/schedule.py: a stamp issued elsewhere claims "
+        "tick coordinates it does not measure, corrupting the "
+        "flight recorder's measured-vs-analytic join. Thread a "
+        "TickRecorder through make_tick_train_step(tick_times=...) "
+        "instead:\n  " + "\n  ".join(offenders)
+    )
+
+
+def test_tick_hook_lint_sees_the_application_sites():
+    # The allowlisted module must actually contain the hooks — if the
+    # stamping moves, the lint must start failing, not silently
+    # allowlist nothing. Both executors stamp (forward + grads), and
+    # the callback itself must be the record call.
+    sched_src = os.path.join(PKG, "models", "schedule.py")
+    with open(sched_src) as fh:
+        text = fh.read()
+    for anchor in ("def _tick_stamp", "def _tick_seed",
+                   "def _tick_rows", "tick_times.record"):
+        assert anchor in text, (
+            f"models/schedule.py lost its {anchor} site — extend "
+            "TICK_HOOK_ALLOWED (and this self-test) to wherever the "
+            "stamping went"
+        )
+    # Self-test of the pattern, like the other lints': call sites
+    # only, prose ignored.
+    assert _tick_hook_in(
+        "        _tick_stamp(tick_times, my, row, 0, y)")
+    assert _tick_hook_in(
+        "jax.debug.callback(tick_times.record, my, t, ph, dep)")
+    assert not _tick_hook_in(
+        "# the _tick_stamp helpers return immediately when off")
+    assert not _tick_hook_in(
+        "``tick_times.record`` receives 0-d arrays")
 
 
 # ---------------------------------------------------- pallas transport

@@ -220,3 +220,22 @@ def test_train_without_obs_emits_nothing(tmp_path):
     assert "obs_step_ms_p50" not in out
     for ln in log.read_text().splitlines():
         assert "obs" not in json.loads(ln)
+
+
+def test_pick_window_step_default_and_override():
+    # Round-20 satellite: the sampled device-trace window step is
+    # configurable (--obs-window-step) so the flight recorder can
+    # sample a steady-state step instead of a warmup one; the default
+    # keeps the historical 2nd-step behavior, and overrides clamp to
+    # the run's [start_step, last-step] range.
+    from tpu_p2p.obs.timeline import pick_window_step
+
+    # Default: the second step of the run (compile lands in the 1st).
+    assert pick_window_step(0, 10) == 1
+    assert pick_window_step(5, 10) == 6  # resumed runs too
+    # A 1-step run has no second step — sample what exists.
+    assert pick_window_step(0, 1) == 0
+    # Explicit choice wins, clamped into the run.
+    assert pick_window_step(0, 10, 7) == 7
+    assert pick_window_step(0, 10, 99) == 9
+    assert pick_window_step(4, 10, 0) == 4

@@ -1,0 +1,255 @@
+"""tpu_p2p.obs.trace: the Chrome-trace exporter — schema contract
+pinned through the validator on good AND deliberately corrupted
+traces, the serve-lifecycle round-trip from the checked-in
+deterministic obs.jsonl fixture, and every track family rendering
+from synthetic inputs (docs/tracing.md)."""
+
+import json
+import os
+
+import pytest
+
+from tpu_p2p.obs import trace as TR
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "golden",
+                       "serve_obs_fixture.jsonl")
+
+
+def _events(obj, pid, ph=None):
+    return [e for e in obj["traceEvents"]
+            if e["pid"] == pid and e["ph"] != "M"
+            and (ph is None or e["ph"] == ph)]
+
+
+# ------------------------------------------------------- fixture load
+
+
+def test_load_obs_records_skips_junk_lines():
+    recs = TR.load_obs_records(FIXTURE)
+    # 9 obs-bearing rows; the comment line and the obs-less record
+    # are dropped by the open-vocabulary contract.
+    assert len(recs) == 9
+    assert all(r.get("obs") for r in recs)
+    kinds = {r["obs"] for r in recs}
+    assert kinds == {"step", "ckpt", "health", "request"}
+
+
+# --------------------------------------------------------- serve track
+
+
+def test_serve_lanes_greedy_assignment_pin():
+    reqs = [r for r in TR.load_obs_records(FIXTURE)
+            if r["obs"] == "request"]
+    # Hand truth: id 0 occupies lane 0 for steps 0-5, id 1 lane 1
+    # (0-7), id 2 lane 2 (1-3, shed end), id 3 REUSES lane 0 (enqueue
+    # 6 >= id 0's finish 5) — the at-most-slots-lanes guarantee.
+    assert TR.serve_lanes(reqs) == {0: 0, 1: 1, 2: 2, 3: 0}
+
+
+def test_serve_roundtrip_from_fixture(tmp_path):
+    out = str(tmp_path / "trace.json")
+    TR.write_chrome_trace(out,
+                          obs_records=TR.load_obs_records(FIXTURE),
+                          meta={"source": "serve"})
+    assert TR.validate_chrome_trace(out) == []
+    with open(out) as fh:
+        obj = json.load(fh)
+    assert obj["otherData"]["source"] == "serve"
+    assert obj["otherData"]["exporter"] == "tpu_p2p.obs.trace"
+    serve = _events(obj, TR.PID_SERVE)
+    by_name = {e["name"]: e for e in serve}
+    # Disagg request 1: queue 0→1, prefill 1→2, migrate_wait 2→3
+    # (prefill_done → migrate), decode 4→7 — step-indexed time at
+    # 1 step = 1000 us.
+    mw = by_name["migrate_wait r1"]
+    assert mw["ts"] == 2000.0 and mw["dur"] == 1000.0
+    assert mw["args"]["migrate_wait_steps"] == 1
+    assert mw["args"]["decode_shard"] == 2
+    dec = by_name["decode r1"]
+    assert dec["ts"] == 4000.0 and dec["dur"] == 3000.0
+    # Colocated request 0 has NO migrate_wait (no disagg fields).
+    assert "migrate_wait r0" not in by_name
+    assert by_name["decode r0"]["dur"] == 3000.0
+    # Shed request 2 stops where its lifecycle stopped: a queue span
+    # to the shed step plus the verdict instant, nothing after.
+    q2 = by_name["queue r2"]
+    assert q2["ts"] == 1000.0 and q2["dur"] == 2000.0
+    shed = by_name["shed_admission r2"]
+    assert shed["ph"] == "i" and shed["ts"] == 3000.0
+    assert "decode r2" not in by_name and "prefill r2" not in by_name
+    # First-token instants for every completed request.
+    for rid, step in ((0, 2), (1, 4), (3, 7)):
+        ft = by_name[f"first_token r{rid}"]
+        assert ft["ph"] == "i" and ft["ts"] == step * 1000.0
+    # Lane metadata: three lanes declared, request 3 rides lane 0.
+    lanes = [e["args"]["name"] for e in obj["traceEvents"]
+             if e["ph"] == "M" and e["pid"] == TR.PID_SERVE
+             and e["name"] == "thread_name"]
+    assert lanes == ["slot lane 0", "slot lane 1", "slot lane 2"]
+    assert by_name["decode r3"]["tid"] == 0
+
+
+# --------------------------------------------------------- train track
+
+
+def test_train_track_relays_steps_sequentially(tmp_path):
+    out = str(tmp_path / "trace.json")
+    obj = TR.write_chrome_trace(
+        out, obs_records=TR.load_obs_records(FIXTURE))
+    assert TR.validate_chrome_trace(obj) == []
+    steps = [e for e in _events(obj, TR.PID_TRAIN, "X")
+             if e["cat"] == "step"]
+    # The stream records durations; the track re-lays steps back to
+    # back (step_ms → us): 0 @ 0+10000, 1 @ 10000+12000, 2 @ 22000.
+    assert [(e["ts"], e["dur"]) for e in steps] == [
+        (0.0, 10000.0), (10000.0, 12000.0), (22000.0, 11000.0)]
+    assert steps[0]["args"]["device_busy_frac"] == 0.8
+    phases = [e for e in _events(obj, TR.PID_TRAIN, "X")
+              if e["cat"] == "phase"]
+    # Step 1's phases start at its re-laid origin and tile forward.
+    s1 = [e for e in phases if e["ts"] >= 10000.0 and e["ts"] < 22000.0]
+    assert [e["ts"] for e in s1] == [10000.0, 13000.0]
+    # Instants land at their step's re-laid timestamp.
+    inst = {e["name"]: e for e in _events(obj, TR.PID_TRAIN, "i")}
+    assert inst["ckpt save"]["ts"] == 10000.0
+    assert inst["health"]["ts"] == 22000.0
+    assert inst["health"]["args"]["verdict"] == "ok"
+
+
+# --------------------------------------- tick / link / unattributed
+
+
+def _tick_spans():
+    return [
+        {"rank": 0, "tick": 0, "start": 5.0, "compute_end": 5.002,
+         "end": 5.003, "kind": "fwd"},
+        {"rank": 0, "tick": 1, "start": 5.003, "compute_end": 5.006,
+         "end": 5.009, "kind": "bwd_input"},
+        {"rank": 1, "tick": 0, "start": 5.001, "compute_end": 5.004,
+         "end": 5.005, "kind": "noop"},
+    ]
+
+
+def test_tick_track_two_spans_per_tick(tmp_path):
+    out = str(tmp_path / "trace.json")
+    obj = TR.write_chrome_trace(out, tick_spans=_tick_spans())
+    assert TR.validate_chrome_trace(obj) == []
+    ticks = _events(obj, TR.PID_TICKS)
+    # Two X events per (rank, tick): the kind-named compute span and
+    # its hop span; epoch is the earliest span start.
+    assert len(ticks) == 6
+    by = {(e["tid"], e["name"]): e for e in ticks}
+    fwd = by[(0, "fwd t0")]
+    assert fwd["ts"] == 0.0 and fwd["dur"] == pytest.approx(2000.0)
+    hop = by[(0, "hop t0")]
+    assert hop["ts"] == pytest.approx(2000.0)
+    assert hop["dur"] == pytest.approx(1000.0)
+    assert by[(1, "noop t0")]["ts"] == pytest.approx(1000.0)
+    assert fwd["args"] == {"tick": 0, "rank": 0, "kind": "fwd"}
+
+
+def test_link_and_unattributed_tracks(tmp_path):
+    links = [{"name": "collective-permute.1", "t0": 10.0, "t1": 10.5,
+              "kind": "ppermute", "wire_bytes": 4096, "tick": 3},
+             {"name": "collective-permute.2", "t0": 10.2, "t1": 10.9,
+              "kind": "ppermute"}]
+    unattr = [("fusion.7", 10.1, 10.4), ("copy.2", 10.0, 10.05)]
+    out = str(tmp_path / "trace.json")
+    obj = TR.write_chrome_trace(out, link_events=links,
+                                unattributed=unattr)
+    assert TR.validate_chrome_trace(obj) == []
+    # Async begin/end pairs, overlapping transfers kept distinct by id.
+    bs = _events(obj, TR.PID_LINKS, "b")
+    es = _events(obj, TR.PID_LINKS, "e")
+    assert len(bs) == 2 and len(es) == 2
+    assert bs[0]["ts"] == 0.0
+    assert bs[0]["args"]["wire_bytes"] == 4096
+    assert {b["id"] for b in bs} == {e["id"] for e in es}
+    # The unmatched device intervals render as their own track —
+    # dropped time stays visible, never silent.
+    ua = _events(obj, TR.PID_UNATTR, "X")
+    assert [e["name"] for e in ua] == ["copy.2", "fusion.7"]
+    assert ua[0]["ts"] == 0.0
+    assert ua[1]["dur"] == pytest.approx(0.3e6)
+
+
+def test_empty_sections_emit_no_tracks(tmp_path):
+    out = str(tmp_path / "trace.json")
+    obj = TR.write_chrome_trace(out, tick_spans=_tick_spans())
+    pids = {e["pid"] for e in obj["traceEvents"]}
+    assert pids == {TR.PID_TICKS}
+
+
+# ----------------------------------------------------------- validator
+
+
+def _good_trace():
+    return {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+         "ts": 0, "args": {"name": "p"}},
+        {"name": "a", "cat": "c", "ph": "X", "pid": 1, "tid": 0,
+         "ts": 0.0, "dur": 5.0},
+        {"name": "b", "cat": "c", "ph": "X", "pid": 1, "tid": 0,
+         "ts": 5.0, "dur": 1.0},
+    ]}
+
+
+def test_validator_accepts_good_trace():
+    assert TR.validate_chrome_trace(_good_trace()) == []
+
+
+def test_validator_flags_missing_required_keys():
+    t = _good_trace()
+    del t["traceEvents"][1]["ts"]
+    probs = TR.validate_chrome_trace(t)
+    assert any("missing" in p and "'ts'" in p for p in probs)
+
+
+def test_validator_flags_non_monotonic_track():
+    t = _good_trace()
+    t["traceEvents"][2]["ts"] = -1.0
+    probs = TR.validate_chrome_trace(t)
+    assert any("bad ts" in p for p in probs)
+    t = _good_trace()
+    t["traceEvents"][1]["ts"] = 9.0  # later than event 2's 5.0
+    probs = TR.validate_chrome_trace(t)
+    assert any("not monotonic" in p for p in probs)
+
+
+def test_validator_flags_unbalanced_async():
+    t = _good_trace()
+    t["traceEvents"].append({"name": "x", "cat": "link", "ph": "b",
+                             "id": 1, "pid": 1, "tid": 0, "ts": 6.0})
+    probs = TR.validate_chrome_trace(t)
+    assert any("unclosed begin" in p for p in probs)
+    t = _good_trace()
+    t["traceEvents"].append({"name": "x", "cat": "link", "ph": "e",
+                             "id": 2, "pid": 1, "tid": 0, "ts": 6.0})
+    probs = TR.validate_chrome_trace(t)
+    assert any("end without begin" in p for p in probs)
+
+
+def test_validator_flags_undeclared_and_duplicate_pids():
+    t = _good_trace()
+    t["traceEvents"][1]["pid"] = 9  # emits on a pid never declared
+    probs = TR.validate_chrome_trace(t)
+    assert any("pid 9" in p and "process_name" in p for p in probs)
+    t = _good_trace()
+    t["traceEvents"].append(dict(t["traceEvents"][0]))  # dup meta
+    probs = TR.validate_chrome_trace(t)
+    assert any("saw 2" in p for p in probs)
+
+
+def test_validator_flags_negative_duration_and_empty():
+    t = _good_trace()
+    t["traceEvents"][1]["dur"] = -1.0
+    assert any("bad dur" in p for p in TR.validate_chrome_trace(t))
+    assert TR.validate_chrome_trace({"traceEvents": []}) == \
+        ["traceEvents is empty"]
+    assert TR.validate_chrome_trace({}) == \
+        ["traceEvents missing or not a list"]
+
+
+def test_validator_unreadable_path(tmp_path):
+    probs = TR.validate_chrome_trace(str(tmp_path / "missing.json"))
+    assert len(probs) == 1 and "unreadable" in probs[0]

@@ -136,6 +136,13 @@ DIRECTIONS = ("uni", "bi", "both")
 TRANSPORTS = ("xla", "pallas_dma")
 PP_SCHEDULES = ("1f1b", "zb")
 TICK_LOWERINGS = ("masked", "switch")
+# Programs the tick flight recorder can compile and profile
+# (tpu_p2p/obs/tickprof.py `obs trace`): the two production backward
+# schedules plus the forward-only GPipe program (whose recorder
+# stamps ride the differentiated forward scan). ONE definition
+# governs the `obs trace` CLI choices and the bench's measured-bubble
+# arm, the PP_SCHEDULES single-source rule.
+TRACE_SCHEDULES = ("zb", "1f1b", "gpipe")
 # Manual-executor tick lowerings (tpu_p2p/models/schedule.py lower()):
 # "masked" = the legacy masked-SPMD execution — every rank runs every
 # tick's full compute body and discards idle work through

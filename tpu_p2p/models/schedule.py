@@ -787,10 +787,60 @@ def _ship(y, axis, edges, wave: bool, pp_chunks: int, transport: str,
     )
 
 
+def _tick_stamp(tick_times, my, row, phase, *deps):
+    """Emit ONE flight-recorder boundary stamp (obs/tickprof.py).
+
+    ``tick_times is None`` (the default everywhere) compiles to
+    NOTHING — no callback, no ``_tick`` column, a bitwise-identical
+    traced program. When set, a ``jax.debug.callback`` records
+    ``(rank, tick, phase, host perf_counter)``; the ``deps`` values
+    are summed into a dead scalar argument purely to sequence the
+    stamp after the tick's real work (data dependence is the only
+    ordering the runtime honors). ``stop_gradient`` keeps the stamp
+    out of autodiff; the step values are untouched either way."""
+    if tick_times is None:
+        return
+    import jax
+    import jax.numpy as jnp
+
+    dep = jnp.float32(0)
+    for d in deps:
+        dep = dep + jax.lax.stop_gradient(
+            jnp.asarray(d).reshape(-1)[0].astype(jnp.float32))
+    jax.debug.callback(tick_times.record, my, row["_tick"],
+                       jnp.int32(phase), dep)
+
+
+def _tick_seed(tick_times, my, x_mb):
+    """The pre-scan seed stamp: tick ``-1``, phase 1 — bounds tick
+    0's duration and delimits step rounds in the recorded stream."""
+    if tick_times is None:
+        return
+    import jax
+    import jax.numpy as jnp
+
+    jax.debug.callback(
+        tick_times.record, my, jnp.int32(-1), jnp.int32(1),
+        jax.lax.stop_gradient(
+            jnp.asarray(x_mb).reshape(-1)[0].astype(jnp.float32)))
+
+
+def _tick_rows(lowered: "LoweredProgram", tick_times):
+    """The scanned row pytree; carries a ``_tick`` index column ONLY
+    when the flight recorder is on (hooks off ⇒ identical rows)."""
+    import jax.numpy as jnp
+
+    rows = {k: jnp.asarray(v) for k, v in lowered.tables.items()}
+    if tick_times is not None:
+        rows["_tick"] = jnp.arange(len(lowered.tables["ship_y"]),
+                                   dtype=jnp.int32)
+    return rows
+
+
 def tick_forward_local(block_fn: Callable, params_local, x_mb,
                        lowered: LoweredProgram, axis: str,
                        pp_overlap: str = "none", pp_chunks: int = 1,
-                       transport: str = "xla"):
+                       transport: str = "xla", tick_times=None):
     """Run a forward-only program — call inside ``shard_map``.
 
     The IR-driven twin of :func:`tpu_p2p.models.pipeline.
@@ -855,16 +905,19 @@ def tick_forward_local(block_fn: Callable, params_local, x_mb,
             )
         else:
             y, outputs = tick_body(prev_in, outputs, row)
+        _tick_stamp(tick_times, my, row, 0, y)
         if n > 1:
             y_next = _ship(y, axis, edges, wave, pp_chunks, transport,
                            label="pp_stage_ship")
         else:
             y_next = zero
+        _tick_stamp(tick_times, my, row, 1, y_next)
         return (y_next, outputs), None
 
     outputs0 = jax.lax.pcast(jnp.zeros_like(x_mb), (axis,),
                              to="varying")
-    rows = {k: jnp.asarray(v) for k, v in lowered.tables.items()}
+    rows = _tick_rows(lowered, tick_times)
+    _tick_seed(tick_times, my, x_mb)
     (_, outputs), _ = jax.lax.scan(tick, (zero, outputs0), rows)
     return C.psum(outputs, axis, label="pp_output_replicate")
 
@@ -876,7 +929,7 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
                      vma_axes: Tuple[str, ...] = (),
                      dparam_vma=None,
                      pp_overlap: str = "none", pp_chunks: int = 1,
-                     transport: str = "xla"):
+                     transport: str = "xla", tick_times=None):
     """Run a backward-carrying program — call inside ``shard_map``.
 
     The generalized :func:`tpu_p2p.models.pipeline_interleaved.
@@ -1128,6 +1181,8 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
         )
         y_f = block_fn(chunk_of(params_local, f_cidx), x_in)
         y_f = jnp.where(f_on, y_f, zero_mb)
+        _tick_stamp(tick_times, my, row, 0, y_f, dx,
+                    jax.tree.leaves(dparams)[0], loss_acc)
 
         if n > 1:
             # Hop elision (see lower()): the whole mesh agrees on the
@@ -1150,6 +1205,7 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
             )
         else:
             y_next, g_next = y_f, dx
+        _tick_stamp(tick_times, my, row, 1, y_next, g_next)
         return (x_stash, g_stash, bnd_stash, y_next, g_next, dparams,
                 loss_acc), None
 
@@ -1275,6 +1331,8 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
                 code, [branch_of[k] for k in lowered.op_table],
                 x_stash, g_stash, bnd_stash, dparams, loss_acc,
             )
+        _tick_stamp(tick_times, my, row, 0, y_f, dx,
+                    jax.tree.leaves(dparams)[0], loss_acc)
 
         if n > 1:
             # Hop elision (see lower()): the whole mesh agrees on the
@@ -1297,13 +1355,15 @@ def tick_grads_local(block_fn: Callable, loss_grad_fn: Callable,
             )
         else:
             y_next, g_next = y_f, dx
+        _tick_stamp(tick_times, my, row, 1, y_next, g_next)
         return (x_stash, g_stash, bnd_stash, y_next, g_next, dparams,
                 loss_acc), None
 
     carry0 = (x_stash0, g_stash0, bnd_stash0, zero_mb,
               varying(jnp.zeros(mb_shape, jnp.float32)), dparams0,
               varying(jnp.zeros((), jnp.float32)))
-    rows = {k: jnp.asarray(v) for k, v in lowered.tables.items()}
+    rows = _tick_rows(lowered, tick_times)
+    _tick_seed(tick_times, my, x_mb)
     (_, _, _, _, _, dparams, loss_acc), _ = jax.lax.scan(
         tick_switch if lowered.lowering == "switch" else tick,
         carry0, rows,
@@ -1317,7 +1377,8 @@ def make_tick_train_step(mesh, cfg, program: TickProgram,
                          loss_grad_fn: Optional[Callable] = None,
                          pp_overlap: str = "none", pp_chunks: int = 1,
                          transport: str = "xla",
-                         tick_lowering: str = "masked"):
+                         tick_lowering: str = "masked",
+                         tick_times=None):
     """ONE jitted SGD step for ANY tick program — the executor every
     schedule compiles to.
 
@@ -1380,7 +1441,7 @@ def make_tick_train_step(mesh, cfg, program: TickProgram,
                 y = tick_forward_local(
                     block_fn, p, x_mb, lowered, pp,
                     pp_overlap=pp_overlap, pp_chunks=pp_chunks,
-                    transport=transport,
+                    transport=transport, tick_times=tick_times,
                 )
                 return jnp.sum(
                     (y.astype(jnp.float32)
@@ -1403,7 +1464,7 @@ def make_tick_train_step(mesh, cfg, program: TickProgram,
             loss_sum, grads = tick_grads_local(
                 block_fn, loss_grad_fn, params, x_mb, t_mb, lowered,
                 pp, pp_overlap=pp_overlap, pp_chunks=pp_chunks,
-                transport=transport,
+                transport=transport, tick_times=tick_times,
             )
             denom = float(np.prod(x.shape))
             new_params = jax.tree.map(

@@ -313,6 +313,12 @@ class TraceJoin:
     # site): {kind: {"events": n, "seconds": total}} — surfaced, not
     # silently dropped, so the ledger's coverage is auditable.
     unmatched: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    # The raw (event_name, t0, t1) intervals behind ``unmatched`` —
+    # kept so the Chrome-trace export can render dropped time as a
+    # distinct "unattributed" track instead of losing it (the
+    # no-silent-caps rule, docs/observability.md; docs/tracing.md).
+    unmatched_intervals: List[Tuple[str, float, float]] = \
+        field(default_factory=list)
     # kinds whose event count was not a whole multiple of the entry
     # count (see module docstring) — joined anyway, flagged here.
     ragged: Tuple[str, ...] = ()
@@ -398,12 +404,14 @@ def join_trace(ledger: CollectiveLedger, trace_dir: str,
         return TraceJoin(no_device_track=True)
     by_kind_events: Dict[str, List[Tuple[str, float, float]]] = {}
     unmatched: Dict[str, Dict[str, float]] = {}
+    unmatched_iv: List[Tuple[str, float, float]] = []
     for name, t0, t1 in intervals:
         kind = kind_of_event(name)
         if kind is None:
             d = unmatched.setdefault("other", {"events": 0, "seconds": 0.0})
             d["events"] += 1
             d["seconds"] += t1 - t0
+            unmatched_iv.append((name, t0, t1))
             continue
         by_kind_events.setdefault(kind, []).append((name, t0, t1))
     by_kind_issues: Dict[str, List[CollectiveIssue]] = {}
@@ -421,6 +429,7 @@ def join_trace(ledger: CollectiveLedger, trace_dir: str,
             d = unmatched.setdefault(kind, {"events": 0, "seconds": 0.0})
             d["events"] += len(evs)
             d["seconds"] += sum(t1 - t0 for _, t0, t1 in evs)
+            unmatched_iv.extend(evs)
             continue
         if len(evs) % len(issues):
             ragged.append(kind)
@@ -430,8 +439,10 @@ def join_trace(ledger: CollectiveLedger, trace_dir: str,
                 event_name=name,
             ))
     joined.sort(key=lambda j: j.t0)
+    unmatched_iv.sort(key=lambda e: e[1])
     return TraceJoin(joined=joined, unmatched=unmatched,
-                     ragged=tuple(sorted(ragged)))
+                     ragged=tuple(sorted(ragged)),
+                     unmatched_intervals=unmatched_iv)
 
 
 # ------------------------------------------------- live capture/report

@@ -47,12 +47,30 @@ import time
 from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["SPAN_KINDS", "StepTimeline", "device_window_record"]
+__all__ = ["SPAN_KINDS", "StepTimeline", "device_window_record",
+           "pick_window_step"]
 
 # Documented span vocabulary (open set — emitters may add kinds, but
 # these names are the schema consumers can rely on).
 SPAN_KINDS = ("data", "gather", "forward", "backward", "optimizer",
               "step", "eval", "checkpoint")
+
+
+def pick_window_step(start_step: int, steps: int,
+                     window_step: Optional[int] = None) -> int:
+    """Which step gets the one sampled ``jax.profiler.trace`` window.
+
+    The default is the SECOND executed step (the first carries XLA
+    compilation), falling back to the first when the run is a single
+    step. ``window_step`` overrides: it is an absolute step index,
+    clamped into the executed range ``[start_step, steps)`` so a
+    stale value from a resumed run still samples something instead of
+    silently sampling nothing.
+    """
+    last = max(start_step, steps - 1)
+    if window_step is not None:
+        return min(max(int(window_step), start_step), last)
+    return start_step + 1 if steps - start_step > 1 else start_step
 
 
 class StepTimeline:

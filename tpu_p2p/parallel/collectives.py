@@ -1016,9 +1016,11 @@ class CollectiveCache:
         """``count`` chained raw-DMA hops in one program — the
         ``transport="pallas_dma"`` twin of :meth:`permute_chain` under
         its benchmark name: the fused/differential unit of the
-        Pallas-transport p2p matrix and the ``ring_gbps_pallas`` /
-        ``p2p_lat_us_pallas`` bench headlines, directly comparable to
-        the XLA chain on the same ``(mesh, edges, count)`` key."""
+        Pallas-transport p2p matrix and the ``ring_gbps_pallas``
+        bench headline (``p2p_lat_us_pallas`` measures beside it in
+        BENCH_detail.json since the round-20 trade), directly
+        comparable to the XLA chain on the same ``(mesh, edges,
+        count)`` key."""
         return self.permute_chain(mesh, axis, edges, count,
                                   transport="pallas_dma")
 

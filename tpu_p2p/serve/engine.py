@@ -322,6 +322,13 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--obs-jsonl", default=None, metavar="PATH",
                    help="append per-request span records + the serve "
                         "summary to this JSONL timeline")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="export the run's request lifecycles (queue/"
+                        "prefill/decode spans, one track per slot "
+                        "lane, disagg migration waits) as a Chrome-"
+                        "trace/Perfetto JSON timeline "
+                        "(docs/tracing.md); works with or without "
+                        "--obs-jsonl")
     p.add_argument("--chaos", action="store_true",
                    help="run the injected-fault chaos smoke instead "
                         "of a plain trace (make serve-chaos; "
@@ -330,6 +337,19 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="testing: force CPU platform with N simulated "
                         "devices")
     return p
+
+
+def _write_serve_trace(path, records) -> None:
+    """``--trace``: the run's emitted obs records (request lifecycles
+    + summaries) as a Chrome-trace timeline (docs/tracing.md)."""
+    if not path:
+        return
+    from tpu_p2p.obs.trace import write_chrome_trace
+
+    obj = write_chrome_trace(path, obs_records=records or (),
+                             meta={"source": "serve"})
+    print(f"# wrote chrome trace {path} "
+          f"({len(obj['traceEvents'])} events)")
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -435,19 +455,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"{prompt_rng[1]} gen {gen_rng[0]}-{gen_rng[1]}")
         emit = None
         fh = None
-        if args.obs_jsonl:
+        trace_records = [] if args.trace else None
+        if args.obs_jsonl or args.trace:
             import json as _json
 
-            fh = open(args.obs_jsonl, "a")
+            if args.obs_jsonl:
+                fh = open(args.obs_jsonl, "a")
 
-            def emit(rec, fh=fh):
-                fh.write(_json.dumps(rec) + "\n")
-                fh.flush()
+            def emit(rec, fh=fh, buf=trace_records):
+                if fh is not None:
+                    fh.write(_json.dumps(rec) + "\n")
+                    fh.flush()
+                if buf is not None:
+                    buf.append(rec)
         if sc.disagg:
             try:
-                return _disagg_cli(pre_mesh, dec_mesh, mig_mesh, mesh,
-                                   cfg, params_seeded, params, trace,
-                                   sc, emit)
+                rc = _disagg_cli(pre_mesh, dec_mesh, mig_mesh, mesh,
+                                 cfg, params_seeded, params, trace,
+                                 sc, emit)
+                _write_serve_trace(args.trace, trace_records)
+                return rc
             finally:
                 if fh is not None:
                     fh.close()
@@ -497,6 +524,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                       f"{busy['continuous']} steps vs static "
                       f"{busy['static']} steps "
                       f"({busy['static'] / max(busy['continuous'], 1):.2f}x)")
+            _write_serve_trace(args.trace, trace_records)
         finally:
             if fh is not None:
                 fh.close()

@@ -112,7 +112,8 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
                  eval_batches: int = 2, clip_norm: float = 0.0,
                  warmup_steps: int = 0, schedule: str = "constant",
                  obs_jsonl: Optional[str] = None, fault_plan=None,
-                 heal: bool = False, health_config=None) -> dict:
+                 heal: bool = False, health_config=None,
+                 obs_window_step: Optional[int] = None) -> dict:
     """Train the flagship for ``steps`` global steps; returns a summary
     dict (``final_loss``, ``steps_run``, ``start_step``, ...).
 
@@ -402,7 +403,11 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
     if obs_jsonl:
         from tpu_p2p.obs import ledger as obs_ledger
         from tpu_p2p.obs.health import HealthMonitor, HostLostError
-        from tpu_p2p.obs.timeline import StepTimeline, device_window_record
+        from tpu_p2p.obs.timeline import (
+            StepTimeline,
+            device_window_record,
+            pick_window_step,
+        )
 
         tl = StepTimeline(emit_obs)
         led = obs_ledger.CollectiveLedger()
@@ -412,10 +417,11 @@ def run_training(mesh, cfg, *, steps: int, lr: float = 1e-2,
         monitor = HealthMonitor(health_config, emit=emit_obs,
                                 n_hosts=int(mesh.devices.size))
         # One sampled device-trace window per run (tracing every step
-        # is the kind of overhead observability must not add): the
-        # SECOND executed step — the first carries XLA compilation.
-        obs_trace_step = (start_step + 1 if steps - start_step > 1
-                          else start_step)
+        # is the kind of overhead observability must not add): by
+        # default the SECOND executed step — the first carries XLA
+        # compilation — overridable via --obs-window-step.
+        obs_trace_step = pick_window_step(start_step, steps,
+                                          obs_window_step)
 
     def _span(name):
         return (tl.span(name) if tl is not None
@@ -745,6 +751,17 @@ def _build_parser() -> argparse.ArgumentParser:
                         "span-timed step rows, a sampled device-trace "
                         "window with collective-ledger join, and an "
                         "obs_step_ms_p50 summary; syncs every step")
+    p.add_argument("--obs-window-step", type=int, default=None,
+                   metavar="K",
+                   help="which step gets the one sampled "
+                        "jax.profiler.trace window (default: the "
+                        "second executed step, past compilation; "
+                        "clamped into the executed range)")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="after the run, export the --obs-jsonl "
+                        "records as a Chrome-trace/Perfetto JSON "
+                        "timeline (docs/tracing.md; requires "
+                        "--obs-jsonl)")
     p.add_argument("--ckpt-dir", default=None, metavar="DIR")
     p.add_argument("--ckpt-every", type=int, default=0, metavar="N")
     from tpu_p2p.config import CKPT_KEEP
@@ -944,8 +961,12 @@ def main(argv=None) -> int:
         eval_every=args.eval_every, eval_batches=args.eval_batches,
         clip_norm=args.clip_norm, warmup_steps=args.warmup_steps,
         schedule=args.schedule, obs_jsonl=args.obs_jsonl,
+        obs_window_step=args.obs_window_step,
         fault_plan=fault_plan,
     )
+    if args.trace and not args.obs_jsonl:
+        raise SystemExit("--trace exports the observability records; "
+                         "it requires --obs-jsonl")
     if args.supervise and args.heal:
         raise SystemExit(
             "--supervise and --heal are separate recovery wrappers; "
@@ -961,6 +982,17 @@ def main(argv=None) -> int:
     else:
         summary = run_training(mesh, cfg, resume=args.resume, **common)
     summary.pop("params")
+    if args.trace:
+        from tpu_p2p.obs.trace import (
+            load_obs_records,
+            write_chrome_trace,
+        )
+
+        obj = write_chrome_trace(
+            args.trace, obs_records=load_obs_records(args.obs_jsonl),
+            meta={"source": "train", "obs_jsonl": args.obs_jsonl})
+        print(f"# wrote chrome trace {args.trace} "
+              f"({len(obj['traceEvents'])} events)")
     print(json.dumps({"summary": summary}))
     return 0
 
