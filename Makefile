@@ -17,7 +17,7 @@ SHELL    := /bin/bash
 
 NATIVE_SO := native/libtpu_p2p_native.so
 
-.PHONY: all native run test tier1 bench obs topo zb trace health serve serve-disagg serve-chaos ckpt-chaos clean
+.PHONY: all native run test tier1 bench obs topo zb trace health serve serve-disagg serve-chaos reuse ckpt-chaos clean
 
 all: native
 
@@ -111,6 +111,18 @@ serve:
 # override with ARGS= on real hardware.
 serve-disagg:
 	$(PYTHON) -m tpu_p2p serve --disagg $(if $(ARGS),$(ARGS),--cpu-mesh 8)
+
+# KV-reuse graded smoke (docs/kv_reuse.md): one seeded shared-prefix
+# burst trace served three ways — baseline, copy-on-write prefix
+# cache, seeded draft-verify speculative decoding — graded on mean
+# TTFT (in scheduler steps) collapsing below 0.5x baseline and on
+# accepted tokens per decode step exceeding 1.0, each under BITWISE
+# token-stream parity with the baseline engine; nonzero exit unless
+# both grade. Prints NULL (exit 0) on <2-device meshes — per-shard
+# sharing grades nothing there. Defaults to the simulated 8-device
+# CPU mesh; override with ARGS= on real hardware.
+reuse:
+	$(PYTHON) -m tpu_p2p serve --reuse $(if $(ARGS),$(ARGS),--cpu-mesh 8)
 
 # Serving-resilience chaos smoke (docs/serving_resilience.md): three
 # injected fault scenarios — page-pool clamp → preemption with zero

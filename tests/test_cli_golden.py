@@ -123,6 +123,16 @@ SUMMARY_PATTERNS = {
     # token-stream mismatch vs the colocated engine.
     "serve_disagg": ["serve", "--cpu-mesh", "8", "--disagg",
                      "--requests", "6", "--seed", "0"],
+    # The round-21 KV-reuse graded smoke end to end on the 8-device
+    # mesh (the `make reuse` grader, docs/kv_reuse.md): one seeded
+    # shared-prefix burst trace served baseline / prefix-cached /
+    # speculative. Hit/page/token/fork/step counts and the PASS
+    # verdicts are schedule-deterministic for the seed and stay
+    # pinned — the golden carries BOTH acceptance grades (TTFT-steps
+    # ratio < 0.5, accepted tokens per decode step > 1.0) plus the
+    # two "parity OK" bitwise pins; every mean/ratio float masks.
+    # _run_cli asserts rc 0 = both grades PASS under parity.
+    "serve_reuse": ["serve", "--cpu-mesh", "8", "--reuse"],
     # The round-15 chaos smoke end to end on the 8-device mesh: three
     # injected fault scenarios (page-pool clamp → preemption, request
     # storm → shedding, slow host → schedule invariance) graded like

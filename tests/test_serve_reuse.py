@@ -1,0 +1,476 @@
+"""KV reuse (round 21 tentpole — copy-on-write prefix caching +
+seeded draft-verify speculative decoding, docs/kv_reuse.md).
+
+The load-bearing pin is BITWISE token-stream parity vs the no-reuse
+engine under every reuse configuration — prefix cache, speculation,
+both together, colocated AND disaggregated with mid-stream page
+migration. Supporting pins: the refcount/COW property fuzz (no page
+frees while referenced, no two writers ever share a page, the pool
+balances exactly at drain), the prefix index lifecycle (chain keys,
+first-writer-wins dedupe, tail-first eviction, release_all
+accounting), exact greedy acceptance (`spec_verify`) and the
+deterministic ngram draft, the dry twin staying event-exact under
+prefix caching (and REFUSING speculation — value-driven), and the
+multi-row mixed step matching sequential single-token steps bitwise
+(the induction's base fact).
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_p2p.config import ServeConfig
+from tpu_p2p.models import flagship as F
+from tpu_p2p.models.decode import ngram_propose, spec_verify
+from tpu_p2p.serve.batcher import Batcher, Request, simulate_schedule
+from tpu_p2p.serve.disagg import (
+    DisaggBatcher,
+    build_disagg_meshes,
+    simulate_disagg_schedule,
+)
+from tpu_p2p.serve.engine import (
+    _engine_model,
+    serve_mesh,
+    shared_prefix_trace,
+)
+from tpu_p2p.serve.paged_cache import (
+    OutOfPages,
+    PagePool,
+    PrefixIndex,
+    kv_page_bytes,
+)
+
+
+# ------------------------------------------------- drafting / verify
+
+
+def test_spec_verify_acceptance_prefixes():
+    # Full accept: draft j+1 equals row j's greedy token for every j.
+    assert spec_verify([5, 7, 9, 2], [5, 7, 9]) == [5, 7, 9, 2]
+    # Partial: acceptance stops at the first mismatch; the mismatch
+    # row's own greedy token is the correction and IS emitted.
+    assert spec_verify([5, 7, 9, 2], [5, 8, 9]) == [5, 7]
+    # Immediate reject still advances one token (never below
+    # baseline).
+    assert spec_verify([5, 7], [5]) == [5, 7]
+    assert spec_verify([5, 3], [4]) == [5]
+    # Window of one (no drafts) is the plain decode step.
+    assert spec_verify([5], []) == [5]
+
+
+def test_spec_verify_shape_mismatch_raises():
+    with pytest.raises(ValueError, match="drafts"):
+        spec_verify([5, 7], [7, 9])
+
+
+def test_ngram_propose_prompt_lookup():
+    # 3 followed 1 most recently, then the draft extends itself:
+    # after proposing 3, the last token is 3, which followed by 1.
+    assert ngram_propose([1, 3, 2, 1], 2) == [3, 2]
+    # No earlier occurrence: repeat the last token.
+    assert ngram_propose([4, 5], 3) == [5, 5, 5]
+    assert ngram_propose([7], 2) == [7, 7]
+    # Deterministic: same history, same proposals.
+    h = [2, 9, 4, 2, 9, 1]
+    assert ngram_propose(h, 4) == ngram_propose(list(h), 4)
+    assert ngram_propose(h, 0) == []
+
+
+# ------------------------------------------------ refcount semantics
+
+
+def test_pool_refcount_retain_free():
+    pool = PagePool(9, 8, 1)
+    a = pool.alloc(0)
+    assert pool.ref(a) == 1
+    pool.retain([a])
+    assert pool.ref(a) == 2
+    pool.free([a])
+    # Still referenced: page must NOT return to the free list.
+    assert pool.ref(a) == 1
+    assert a in pool.allocated(0)
+    pool.free([a])
+    assert pool.ref(a) == 0
+    assert a not in pool.allocated(0)
+    assert pool.available(0) == pool.capacity
+
+
+def test_pool_refcount_errors():
+    pool = PagePool(9, 8, 1)
+    a = pool.alloc(0)
+    with pytest.raises(ValueError, match="retain"):
+        pool.retain([a + 1])
+    # Repeated pid in ONE retain call is legal: two references.
+    pool.retain([a, a])
+    assert pool.ref(a) == 3
+    # Repeated pid in one FREE call stays an error, refcounts or not.
+    with pytest.raises(ValueError, match="not allocated"):
+        pool.free([a, a])
+    assert pool.ref(a) == 3  # atomic: nothing moved
+    pool.free([a])
+    pool.free([a])
+    pool.free([a])
+    assert pool.available(0) == pool.capacity
+
+
+def test_refcount_cow_property_fuzz():
+    """Randomized holder churn over one shard: admissions that map
+    shared pages, COW forks before writes, registrations (index-like
+    base references), evictions, finishes. Invariants after EVERY
+    operation: a referenced page is never on the free list, a write
+    target always has refcount 1 post-fork (no two writers share a
+    page), and the host shadow model matches the pool exactly; at
+    drain the pool balances to full."""
+    rng = np.random.default_rng(1234)
+    for _ in range(4):
+        pool = PagePool(17, 8, 1)  # 16 usable
+        shadow: dict = {}          # pid -> refcount
+        holders: list = []         # each: list of pids it maps
+        registry: list = []        # index-like base references
+
+        def invariants():
+            assert pool.allocated(0) == frozenset(shadow)
+            for pid, n in shadow.items():
+                assert pool.ref(pid) == n > 0
+            assert pool.available(0) == pool.capacity - len(shadow)
+
+        for _ in range(400):
+            op = rng.integers(0, 5)
+            if op == 0:  # admit: maybe map a shared page + fresh ones
+                pages = []
+                if registry and rng.integers(0, 2):
+                    pid = registry[int(rng.integers(0, len(registry)))]
+                    pool.retain([pid])
+                    shadow[pid] += 1
+                    pages.append(pid)
+                try:
+                    for _ in range(int(rng.integers(1, 3))):
+                        pid = pool.alloc(0)
+                        shadow[pid] = 1
+                        pages.append(pid)
+                except OutOfPages:
+                    pass
+                if pages:
+                    holders.append(pages)
+            elif op == 1 and holders:  # write w/ COW fork
+                h = holders[int(rng.integers(0, len(holders)))]
+                j = int(rng.integers(0, len(h)))
+                if pool.ref(h[j]) > 1:
+                    try:
+                        new = pool.alloc(0)
+                    except OutOfPages:
+                        continue
+                    shadow[new] = 1
+                    old = h[j]
+                    h[j] = new
+                    pool.free([old])
+                    shadow[old] -= 1
+                    if not shadow[old]:
+                        del shadow[old]
+                # The COW rule: the page about to be written is
+                # exclusively held.
+                assert pool.ref(h[j]) == 1
+            elif op == 2 and holders:  # finish: atomic free
+                h = holders.pop(int(rng.integers(0, len(holders))))
+                pool.free(h)
+                for pid in h:
+                    shadow[pid] -= 1
+                    if not shadow[pid]:
+                        del shadow[pid]
+            elif op == 3 and holders:  # register a holder page
+                h = holders[int(rng.integers(0, len(holders)))]
+                pid = h[int(rng.integers(0, len(h)))]
+                if pid not in registry:
+                    pool.retain([pid])
+                    shadow[pid] += 1
+                    registry.append(pid)
+            elif op == 4 and registry:  # evict newest registration
+                pid = registry.pop()
+                pool.free([pid])
+                shadow[pid] -= 1
+                if not shadow[pid]:
+                    del shadow[pid]
+            invariants()
+        # Drain: every holder finishes, every registration evicts.
+        for h in holders:
+            pool.free(h)
+        for pid in registry:
+            pool.free([pid])
+        assert pool.available(0) == pool.capacity
+        assert not pool.allocated(0)
+
+
+# ----------------------------------------------------- prefix index
+
+
+def test_prefix_index_chain_lookup_and_dedupe():
+    pool = PagePool(17, 8, 1)
+    idx = PrefixIndex(pool)
+    prompt = np.arange(20, dtype=np.int32)  # 2 full pages + tail
+    pages = pool.alloc_n(3, 0)
+    assert idx.register(prompt, pages[:2]) == 2
+    assert idx.held() == 2
+    # Registration retained: the request can free its own refs and
+    # the indexed pages survive.
+    pool.free(pages)
+    assert pool.ref(pages[0]) == 1 and pool.ref(pages[1]) == 1
+    assert pool.ref(pages[2]) == 0
+    # Chain hit: full shared pages only, in order.
+    assert idx.lookup(prompt) == pages[:2]
+    # A prompt sharing one page matches a one-page chain.
+    other = np.concatenate([prompt[:8],
+                            np.full(12, 63, np.int32)])
+    assert idx.lookup(other) == pages[:1]
+    # Divergence before the boundary: no match at all.
+    assert idx.lookup(prompt[1:]) == []
+    # First writer wins: re-registering with different pages adds 0.
+    p2 = pool.alloc_n(2, 0)
+    assert idx.register(prompt, p2) == 0
+    assert idx.lookup(prompt) == pages[:2]
+    pool.free(p2)
+    # Tail-first eviction: matches shorten, chains keep their heads.
+    assert idx.evict_one()
+    assert idx.lookup(prompt) == pages[:1]
+    idx.release_all()
+    assert not idx.held()
+    assert pool.available(0) == pool.capacity
+
+
+def test_kv_page_bytes_matches_migrator_arithmetic():
+    cfg = _engine_model(ServeConfig(vocab=64))
+    # 2 (K+V) * stages * H_kv * page_len * Dh * 4B
+    assert kv_page_bytes(cfg, 8) == (2 * cfg.stages * cfg.num_kv_heads
+                                     * 8 * cfg.head_dim * 4)
+
+
+# ----------------------------------------------------- config knobs
+
+
+def test_spec_k_validation():
+    with pytest.raises(ValueError, match="spec_k"):
+        ServeConfig(spec_k=8)
+    with pytest.raises(ValueError, match="spec_k"):
+        ServeConfig(spec_k=-1)
+    ServeConfig(spec_k=7, prefix_cache=True)  # legal
+
+
+def test_dry_refuses_speculation_but_not_prefix():
+    with pytest.raises(ValueError, match="VALUE-driven"):
+        Batcher(None, None, None, slots=2, page_len=8, num_pages=8,
+                max_blocks=2, chunk=2, dry=True, n_shards=1,
+                spec_k=2)
+    with pytest.raises(ValueError, match="VALUE-driven"):
+        DisaggBatcher(None, None, None, None, None, None, slots=2,
+                      prefill_slots=1, page_len=8, num_pages=8,
+                      prefill_pages=8, max_blocks=2, chunk=2,
+                      dry=True, n_decode_shards=1, spec_k=2)
+    # Prefix caching is value-free over PROMPTS the dry twin has.
+    out = simulate_schedule([], slots=2, page_len=8, num_pages=8,
+                            max_blocks=2, chunk=2, prefix_cache=True)
+    assert out["prefix_hits"] == 0
+
+
+# --------------------------------------------- shared-prefix traces
+
+
+def test_shared_prefix_trace_seeded_and_validated():
+    sc = ServeConfig(requests=6, seed=3, prompt_len=(16, 20),
+                     gen_len=(2, 4), vocab=64)
+    a = shared_prefix_trace(sc, 16)
+    b = shared_prefix_trace(sc, 16)
+    assert len(a) == 6
+    for ra, rb in zip(a, b):
+        assert np.array_equal(ra.prompt, rb.prompt)
+        assert ra.max_new == rb.max_new
+        assert ra.arrival_step == 0  # burst
+        assert np.array_equal(ra.prompt[:16], a[0].prompt[:16])
+    with pytest.raises(ValueError, match="prefix"):
+        shared_prefix_trace(sc, 24)
+
+
+# ------------------------------------------ colocated bitwise parity
+
+
+def _reuse_trace(vocab, prefix_len, n, rng, exact_every=3):
+    """Shared-prefix requests; every ``exact_every``-th prompt is the
+    EXACT prefix (zero suffix) — the partial-tail COW fork case."""
+    prefix = rng.integers(0, vocab, prefix_len).astype(np.int32)
+    out = []
+    for rid in range(n):
+        if rid % exact_every == exact_every - 1:
+            prompt = prefix.copy()
+        else:
+            sfx = rng.integers(0, vocab,
+                               int(rng.integers(2, 6))).astype(np.int32)
+            prompt = np.concatenate([prefix, sfx])
+        out.append(Request(rid=rid, prompt=prompt,
+                           max_new=int(rng.integers(4, 8)),
+                           arrival_step=rid))
+    return out
+
+
+def _streams(fin):
+    return {r.rid: list(r.generated) for r in fin}
+
+
+@pytest.mark.parametrize("page_len,chunk,prefix_len", [
+    (8, 4, 24),
+    (16, 4, 32),   # mid-page fork: preserved rows genuinely re-read
+], ids=["L8c4", "L16c4"])
+def test_colocated_reuse_bitwise_parity(page_len, chunk, prefix_len):
+    mesh = serve_mesh(2)
+    sc = ServeConfig(slots=2, page_len=page_len, num_pages=24,
+                     max_blocks=6, chunk=chunk, vocab=64,
+                     prompt_len=(4, 8), gen_len=(4, 8))
+    cfg = _engine_model(sc)
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    trace = _reuse_trace(64, prefix_len, 8,
+                         np.random.default_rng(5))
+
+    def run(**kw):
+        b = Batcher(mesh, cfg, params, slots=2, page_len=page_len,
+                    num_pages=24, max_blocks=6, chunk=chunk, **kw)
+        fin = b.run([r.fresh() for r in trace])
+        return b, _streams(fin)
+
+    _, want = run()
+    bp, got_p = run(prefix_cache=True)
+    bs, got_s = run(spec_k=3)
+    bb, got_b = run(prefix_cache=True, spec_k=3)
+    assert got_p == want
+    assert got_s == want
+    assert got_b == want
+    # Reuse actually engaged (not a vacuous parity).
+    assert bp.prefix_hits > 0 and bp.prefix_tokens_saved > 0
+    assert bp.cow_forks > 0  # the exact-prefix prompts force forks
+    assert bs.spec_drafted > 0 and bs.decode_steps > 0
+    # Refcount accounting balances through the index at drain.
+    bp.prefix_index.release_all()
+    assert all(bp.pool_alloc.available(s) == bp.pool_alloc.capacity
+               for s in range(bp.n_shards))
+    # Per-request receipts rode along.
+    assert sum(r.prefix_tokens for r in bp.finished) \
+        == bp.prefix_tokens_saved
+    assert sum(r.spec_accepted for r in bs.finished) \
+        == bs.spec_accepted
+    # Reuse events carry renderable kinds (obs/trace.py instants).
+    kinds = {e["kind"] for e in bp.reuse_events}
+    assert kinds == {"prefix_hit"}
+    kinds = {e["kind"] for e in bs.reuse_events}
+    assert kinds <= {"spec_accept", "spec_reject"} and kinds
+
+
+def test_colocated_prefix_dry_matches_real():
+    mesh = serve_mesh(2)
+    sc = ServeConfig(slots=2, page_len=8, num_pages=24, max_blocks=6,
+                     chunk=4, vocab=64, prompt_len=(4, 8))
+    cfg = _engine_model(sc)
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    trace = _reuse_trace(64, 24, 8, np.random.default_rng(9))
+    b = Batcher(mesh, cfg, params, slots=2, page_len=8, num_pages=24,
+                max_blocks=6, chunk=4, prefix_cache=True)
+    b.run([r.fresh() for r in trace])
+    sim = simulate_schedule([r.fresh() for r in trace], slots=2,
+                            page_len=8, num_pages=24, max_blocks=6,
+                            chunk=4, n_shards=2, prefix_cache=True)
+    assert sim["prefix_hits"] == b.prefix_hits
+    assert sim["prefix_tokens_saved"] == b.prefix_tokens_saved
+    assert sim["steps"] - sim["idle_steps"] \
+        == b.step_idx - b.idle_steps
+
+
+def test_multi_row_decode_matches_single_row_bitwise():
+    """The acceptance induction's base fact: one mixed step scoring a
+    w-token decode window produces each row's logits BITWISE equal to
+    w sequential single-token steps over the same pages."""
+    mesh = serve_mesh(2)
+    sc = ServeConfig(slots=2, page_len=8, num_pages=24, max_blocks=6,
+                     chunk=4, vocab=64, prompt_len=(4, 8))
+    cfg = _engine_model(sc)
+    params = F.place_flagship_params(F.init_flagship_params(cfg), mesh)
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, 64, 9).astype(np.int32)
+    req = Request(rid=0, prompt=prompt, max_new=6, arrival_step=0)
+
+    def greedy_stream(spec_k):
+        b = Batcher(mesh, cfg, params, slots=2, page_len=8,
+                    num_pages=24, max_blocks=6, chunk=4,
+                    spec_k=spec_k)
+        fin = b.run([req.fresh()])
+        return list(fin[0].generated)
+
+    base = greedy_stream(0)
+    # With lookup drafting over a greedy stream, accepted windows are
+    # exactly where the multi-row rows reproduced the single-row
+    # logits' argmax — the streams must agree token for token.
+    assert greedy_stream(3) == base
+
+
+# ---------------------------------------------- disagg composition
+
+
+def test_disagg_reuse_bitwise_parity_with_migration():
+    pre, dec, mig = build_disagg_meshes(1, devices=jax.devices()[:3])
+    mesh = serve_mesh(2)
+    sc = ServeConfig(slots=2, page_len=8, num_pages=24, max_blocks=6,
+                     chunk=4, vocab=64, prompt_len=(4, 8))
+    cfg = _engine_model(sc)
+    seeded = F.init_flagship_params(cfg)
+    params_co = F.place_flagship_params(seeded, mesh)
+    params_p = F.place_flagship_params(seeded, pre)
+    params_d = F.place_flagship_params(seeded, dec)
+    trace = _reuse_trace(64, 24, 8, np.random.default_rng(5))
+    b = Batcher(mesh, cfg, params_co, slots=2, page_len=8,
+                num_pages=24, max_blocks=6, chunk=4)
+    want = _streams(b.run([r.fresh() for r in trace]))
+
+    def run_d(**kw):
+        db = DisaggBatcher(pre, dec, mig, cfg, params_p, params_d,
+                           slots=2, prefill_slots=2, page_len=8,
+                           num_pages=24, prefill_pages=25,
+                           max_blocks=6, chunk=4, **kw)
+        return db, _streams(db.run([r.fresh() for r in trace]))
+
+    dp_, got_p = run_d(prefix_cache=True)
+    ds_, got_s = run_d(spec_k=3)
+    db_, got_b = run_d(prefix_cache=True, spec_k=3)
+    assert got_p == want
+    assert got_s == want
+    assert got_b == want
+    # Reuse engaged AND pages crossed the bank boundary mid-stream.
+    assert dp_.prefix_hits > 0 and dp_.cow_forks > 0
+    assert len(dp_.migrate_events) > 0
+    assert ds_.spec_drafted > 0
+    # Refcounts preserved across migration: index holds survive the
+    # post-migration prefill-side free, and the whole system still
+    # balances at drain.
+    assert dp_.prefix_index.held(0) > 0
+    dp_.prefix_index.release_all()
+    assert dp_.pool_p.available(0) == dp_.pool_p.capacity
+    assert all(dp_.pool_d.available(s) == dp_.pool_d.capacity
+               for s in range(dp_.n_dec))
+
+
+def test_disagg_prefix_dry_matches_real():
+    pre, dec, mig = build_disagg_meshes(1, devices=jax.devices()[:3])
+    sc = ServeConfig(slots=2, page_len=8, num_pages=24, max_blocks=6,
+                     chunk=4, vocab=64, prompt_len=(4, 8))
+    cfg = _engine_model(sc)
+    seeded = F.init_flagship_params(cfg)
+    trace = _reuse_trace(64, 24, 8, np.random.default_rng(9))
+    db = DisaggBatcher(pre, dec, mig, cfg,
+                       F.place_flagship_params(seeded, pre),
+                       F.place_flagship_params(seeded, dec),
+                       slots=2, prefill_slots=2, page_len=8,
+                       num_pages=24, prefill_pages=25, max_blocks=6,
+                       chunk=4, prefix_cache=True)
+    db.run([r.fresh() for r in trace])
+    sim = simulate_disagg_schedule(
+        [r.fresh() for r in trace], slots=2, prefill_slots=2,
+        page_len=8, num_pages=24, prefill_pages=25, max_blocks=6,
+        chunk=4, n_decode_shards=2, prefix_cache=True)
+    assert sim["prefix_hits"] == db.prefix_hits
+    assert sim["prefix_tokens_saved"] == db.prefix_tokens_saved
+    assert sim["steps"] == db.step_idx

@@ -87,30 +87,48 @@ def test_tick_wall_durations_take_max_over_ranks():
 
 
 def _synth_program():
-    # A hand-built program whose (cost, hops) design has full rank —
-    # the COMPILED schedules ship a constant hop count per tick, so
-    # only a synthetic program can separate the intercept from the
-    # per-hop coefficient and pin exact recovery.
+    # A hand-built program whose (cost, effective_hops) design has
+    # full rank. Payloads matter: the fit counts POST-ELISION hops,
+    # so bwd ticks ship gradient hops (not activation), and the
+    # bwd_weight tick's activation hop is elided — effective 0 —
+    # which is itself part of what these tests pin.
     def op(kind):
         return (SCH.TickOp(kind=kind, device=0, chunk=0,
                            microbatch=0),)
 
-    hop = SCH.TickHop(payload="activation", edges=())
+    act = SCH.TickHop(payload="activation", edges=())
+    grad = SCH.TickHop(payload="gradient", edges=())
     ticks = (
         SCH.Tick(compute=op("fwd"), hops=()),
-        SCH.Tick(compute=op("fwd"), hops=(hop,)),
+        SCH.Tick(compute=op("fwd"), hops=(act,)),
         SCH.Tick(compute=op("bwd"), hops=()),
-        SCH.Tick(compute=op("bwd"), hops=(hop, hop)),
-        SCH.Tick(compute=op("bwd_weight"), hops=(hop,)),
+        SCH.Tick(compute=op("bwd"), hops=(grad, grad)),
+        SCH.Tick(compute=op("bwd_weight"), hops=(act,)),
     )
     return SCH.TickProgram(name="synth", devices=1, chunks=1,
                            microbatches=1, ticks=ticks)
 
 
+def test_effective_hops_mirrors_executor_elision():
+    # effective_hops replicates lower()'s ship_y/ship_g rule on the
+    # IR: activation ships iff a fwd op runs, gradient iff bwd or
+    # bwd_input, unknown payloads count as shipped (conservative).
+    prog = _synth_program()
+    assert [TP.effective_hops(t) for t in prog.ticks] == \
+        [0, 1, 0, 2, 0]  # bwd_weight's activation hop is elided
+    mystery = SCH.Tick(
+        compute=(SCH.TickOp(kind="bwd_weight", device=0, chunk=0,
+                            microbatch=0),),
+        hops=(SCH.TickHop(payload="halo", edges=()),))
+    assert TP.effective_hops(mystery) == 1
+
+
 def test_kind_decomposition_recovers_planted_cost_model():
     # Plant durations that ARE the model — duration_ms = 1.0 +
-    # 2.0*cost + 0.5*hops — on a full-rank synthetic program and the
-    # fit must recover all three coefficients exactly.
+    # 2.0*cost + 0.5*effective_hops — on a full-rank synthetic
+    # program and the fit must recover all three coefficients
+    # exactly. Planting against len(tick.hops) instead would leak
+    # the elided bwd_weight hop into the intercept.
     from tpu_p2p.models.schedule import OP_COST
 
     prog = _synth_program()
@@ -118,9 +136,11 @@ def test_kind_decomposition_recovers_planted_cost_model():
     for t, tick in enumerate(prog.ticks):
         cost = max((OP_COST[op.kind] for op in tick.compute),
                    default=0.0)
-        dur[t] = (1.0 + 2.0 * cost + 0.5 * len(tick.hops)) / 1e3
+        dur[t] = (1.0 + 2.0 * cost
+                  + 0.5 * TP.effective_hops(tick)) / 1e3
     d = TP.kind_decomposition(dur, prog)
     assert d["intercept_from_fit"] is True
+    assert d["hop_design_varies"] is True
     assert d["constant_overhead_ms"] == pytest.approx(1.0, abs=1e-6)
     assert d["ms_per_cost_unit"] == pytest.approx(2.0, abs=1e-6)
     assert d["ms_per_hop"] == pytest.approx(0.5, abs=1e-6)
@@ -131,11 +151,38 @@ def test_kind_decomposition_recovers_planted_cost_model():
     assert kinds["bwd"]["mean_ms"] > kinds["bwd_weight"]["mean_ms"]
 
 
+def test_kind_decomposition_full_rank_on_real_zb():
+    # Round 21: the round-20 report called the fit's design collinear
+    # because every compiled tick carries the SAME static hop tuple.
+    # Counting effective (post-elision) hops de-collinearizes it on
+    # the real zb program — W-only drain ticks ship 0, warmup/drain
+    # 1, steady state 2 — so planted coefficients now come back
+    # exactly, which was impossible before (the hop column was a
+    # constant the intercept absorbed).
+    from tpu_p2p.models.schedule import OP_COST
+
+    prog = SCH.compile_zb(4, 8)
+    eff = [TP.effective_hops(t) for t in prog.ticks]
+    assert len(set(eff)) >= 3  # 0 / 1 / 2 all occur
+    dur = np.zeros(prog.num_ticks)
+    for t, tick in enumerate(prog.ticks):
+        cost = max((OP_COST[op.kind] for op in tick.compute),
+                   default=0.0)
+        dur[t] = (1.5 + 3.0 * cost + 0.25 * eff[t]) / 1e3
+    d = TP.kind_decomposition(dur, prog)
+    assert d["hop_design_varies"] is True
+    assert d["intercept_from_fit"] is True
+    assert d["constant_overhead_ms"] == pytest.approx(1.5, abs=1e-6)
+    assert d["ms_per_cost_unit"] == pytest.approx(3.0, abs=1e-6)
+    assert d["ms_per_hop"] == pytest.approx(0.25, abs=1e-6)
+
+
 def test_kind_decomposition_group_means_exact_on_zb():
-    # On the real zb program every tick ships the same hop count, so
-    # the planted model collapses per kind to a single value the
-    # group means must reproduce exactly: fwd/bwd_input ticks (cost
-    # 1.0, 2 hops) → 1+2+1 = 4.0 ms, bwd_weight (cost 0.5) → 3.0 ms.
+    # Plant against the RAW hop tuple — constant 2 on every zb tick
+    # — so the planted model collapses per kind to a single value
+    # the group means must reproduce exactly: fwd/bwd_input ticks
+    # (cost 1.0, 2 raw hops) → 1+2+1 = 4.0 ms, bwd_weight (cost
+    # 0.5) → 3.0 ms.
     from tpu_p2p.models.schedule import OP_COST
 
     prog = SCH.compile_zb(4, 4)
@@ -149,10 +196,13 @@ def test_kind_decomposition_group_means_exact_on_zb():
     assert kinds["fwd"]["mean_ms"] == pytest.approx(4.0)
     assert kinds["bwd_input"]["mean_ms"] == pytest.approx(4.0)
     assert kinds["bwd_weight"]["mean_ms"] == pytest.approx(3.0)
-    # Rank-deficient design (constant hops): the published constant
-    # must still be positive however lstsq splits the collinearity.
-    assert d["constant_overhead_ms"] is not None
-    assert d["constant_overhead_ms"] > 0
+    # The planted data carries NO per-effective-hop signal (the raw
+    # count is constant, i.e. pure intercept), and the round-21
+    # full-rank design must say so: the 0.5*2 folds into the
+    # constant and ms_per_hop comes back zero, not smeared.
+    assert d["hop_design_varies"] is True
+    assert d["constant_overhead_ms"] == pytest.approx(2.0, abs=1e-6)
+    assert d["ms_per_hop"] == pytest.approx(0.0, abs=1e-6)
 
 
 def test_kind_decomposition_falls_back_to_min_tick_floor():

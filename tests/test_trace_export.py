@@ -89,6 +89,48 @@ def test_serve_roundtrip_from_fixture(tmp_path):
     assert by_name["decode r3"]["tid"] == 0
 
 
+def test_serve_reuse_instants_ride_request_lanes(tmp_path):
+    # Round-21 KV-reuse events ({"obs": "serve_reuse"}, emitted by
+    # run_engine/run_disagg_engine when --prefix-cache/--spec-k are
+    # on) render as instants ON the owning request's slot lane: a
+    # prefix_hit at admission, one spec_accept/spec_reject per mixed
+    # verify step (docs/kv_reuse.md).
+    recs = [
+        {"obs": "request", "id": 0, "enqueue_step": 0,
+         "prefill_start_step": 0, "prefill_done_step": 1,
+         "first_token_step": 1, "finish_step": 5,
+         "outcome": "finished"},
+        {"obs": "request", "id": 1, "enqueue_step": 0,
+         "prefill_start_step": 1, "prefill_done_step": 2,
+         "first_token_step": 2, "finish_step": 6,
+         "outcome": "finished"},
+        {"obs": "serve_reuse", "kind": "prefix_hit", "rid": 1,
+         "step": 0, "pages": 6, "tokens": 48},
+        {"obs": "serve_reuse", "kind": "spec_accept", "rid": 0,
+         "step": 3, "drafted": 3, "accepted": 3},
+        {"obs": "serve_reuse", "kind": "spec_reject", "rid": 1,
+         "step": 4, "drafted": 3, "accepted": 0},
+        # No lifecycle row for rid 99 in this stream slice → no lane
+        # → the instant is skipped, never misplaced on lane 0.
+        {"obs": "serve_reuse", "kind": "prefix_hit", "rid": 99,
+         "step": 2, "pages": 1, "tokens": 8},
+    ]
+    out = str(tmp_path / "trace.json")
+    obj = TR.write_chrome_trace(out, obs_records=recs)
+    assert TR.validate_chrome_trace(obj) == []
+    inst = {e["name"]: e for e in _events(obj, TR.PID_SERVE, "i")}
+    hit = inst["prefix_hit r1"]
+    assert hit["ts"] == 0.0 and hit["tid"] == 1
+    assert hit["args"] == {"rid": 1, "pages": 6, "tokens": 48}
+    acc = inst["spec_accept r0"]
+    assert acc["ts"] == 3000.0 and acc["tid"] == 0
+    assert acc["args"]["drafted"] == 3 and acc["args"]["accepted"] == 3
+    rej = inst["spec_reject r1"]
+    assert rej["ts"] == 4000.0 and rej["tid"] == 1
+    assert rej["args"]["accepted"] == 0  # a zero survives the filter
+    assert "prefix_hit r99" not in inst
+
+
 # --------------------------------------------------------- train track
 
 

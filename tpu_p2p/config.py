@@ -392,6 +392,15 @@ class ServeConfig:
     transport: str = "xla"    # migration ship transport, one of
     # TRANSPORTS — the same knob the p2p workloads carry
     # (xla = CollectivePermute, pallas_dma = raw async remote copies)
+    # KV reuse (round 21, docs/kv_reuse.md) — both default-off,
+    # preserving the baseline engine byte for byte:
+    prefix_cache: bool = False  # content-hash full prompt pages into
+    # a refcounted per-shard index; a matching prefix maps the shared
+    # pages copy-on-write instead of re-prefilling them
+    spec_k: int = 0           # speculative decoding: up to this many
+    # draft tokens verified per decode step through ONE mixed step
+    # (0 = off; the window additionally respects the chunk width and
+    # the 8-row write band, so spec_k > chunk-1 never helps)
 
     def __post_init__(self) -> None:
         if self.page_len <= 0 or self.page_len % 8:
@@ -451,6 +460,12 @@ class ServeConfig:
         if self.migrate_chunks < 1:
             raise ValueError(
                 f"migrate_chunks must be >= 1, got {self.migrate_chunks}"
+            )
+        if not 0 <= self.spec_k <= 7:
+            raise ValueError(
+                f"spec_k must be in 0..7 (a decode window of 1 + "
+                f"spec_k tokens can never exceed the 8-row write "
+                f"band), got {self.spec_k}"
             )
         if self.prefill_tp < 0 or self.prefill_pages < 0:
             raise ValueError(

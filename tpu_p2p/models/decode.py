@@ -479,3 +479,75 @@ def generate(step_fn, params, cache: Cache, x0, num_tokens: int,
              start_pos: int = 0) -> Tuple[Cache, jax.Array]:
     """Convenience wrapper over :func:`make_generate`."""
     return make_generate(step_fn, num_tokens, start_pos)(params, cache, x0)
+
+
+# --------------------------------------------------- speculative decode
+
+def ngram_propose(history, k: int):
+    """Draft ``k`` tokens by prompt lookup (PAPERS.md "prompt lookup
+    decoding"): each proposal is the token that followed the most
+    recent earlier occurrence of the current last token; with no
+    earlier occurrence, repeat the last token. Deterministic — the
+    draft is a pure function of the request's own token history, so a
+    fixed-seed trace fixes every proposal (the "seeded draft" the
+    reuse smoke grades). No model runs here: the draft costs a host
+    scan, and ALL model compute stays in the target's verify step.
+
+    Greedy streams of the serving engine's model repeat heavily
+    (small random-init LMs collapse into loops), which is exactly the
+    regime where lookup drafting shines; on streams with no
+    repetition every proposal is simply rejected and the engine
+    degrades to one token per step — never below the baseline.
+    """
+    hist = [int(t) for t in history]
+    out = []
+    for _ in range(k):
+        t = hist[-1]
+        nxt = t
+        for i in range(len(hist) - 2, -1, -1):
+            if hist[i] == t:
+                nxt = hist[i + 1]
+                break
+        out.append(nxt)
+        hist.append(nxt)
+    return out
+
+
+def spec_verify(greedy_rows, drafts):
+    """Exact greedy acceptance off ONE verify step's logits; → the
+    tokens to emit, bitwise the target's own stream by construction.
+
+    The verify step fed ``[t0, d1 .. d_{w-1}]`` at positions
+    ``p .. p+w-1`` (``t0`` = the last committed token, ``d`` = draft
+    proposals); ``greedy_rows[j]`` is the argmax of row ``j``'s
+    logits. Why the emitted prefix is exactly the target's stream:
+
+    - Row 0's context is committed tokens only, so ``v0 =
+      greedy_rows[0]`` IS the target's next token — always emitted
+      (a fully rejected window still advances one token; speculation
+      never costs tokens, only the wasted rows' FLOPs).
+    - Inductively, if ``d1..dj`` each matched ``v0..v_{j-1}``, row
+      ``j``'s context equals the committed stream extended by the
+      target's own tokens, so ``v_j = greedy_rows[j]`` is again the
+      target's next token. The accepted prefix stops at the first
+      mismatch; everything after it saw a context the target would
+      never produce, and is discarded.
+    - Equality is BITWISE, not merely argmax-stable: the multi-row
+      mixed step computes each row's logits from the same page-
+      resident KV and the same ``_attend_ffn`` body as w sequential
+      single-token steps (pinned in tests/test_serve_reuse.py).
+
+    ``greedy_rows`` has ``w`` entries, ``drafts`` the trailing
+    ``w-1`` proposals; returns 1..w ints.
+    """
+    rows = [int(t) for t in greedy_rows]
+    drafts = [int(d) for d in drafts]
+    if len(drafts) != len(rows) - 1:
+        raise ValueError(
+            f"spec_verify: {len(rows)} logits rows verify exactly "
+            f"{len(rows) - 1} drafts, got {len(drafts)}"
+        )
+    m = 0
+    while m < len(drafts) and drafts[m] == rows[m]:
+        m += 1
+    return rows[:m + 1]

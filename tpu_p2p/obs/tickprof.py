@@ -29,9 +29,19 @@ ROADMAP.md) stayed a hypothesis. The recorder closes that loop:
 - **Per-tick-kind decomposition.** Global tick wall durations (max
   over ranks) regress against the IR's own cost model — intercept +
   analytic tick cost (:data:`~tpu_p2p.models.schedule.OP_COST`
-  units) + hop count — so the fit's intercept IS the per-tick
-  constant overhead the ROADMAP residual hypothesized, in ms, next
-  to per-kind mean tick costs (fwd / bwd / bwd_input / bwd_weight).
+  units) + EFFECTIVE hop count — so the fit's intercept IS the
+  per-tick constant overhead the ROADMAP residual hypothesized, in
+  ms, next to per-kind mean tick costs (fwd / bwd / bwd_input /
+  bwd_weight). Effective means post-elision
+  (:func:`effective_hops`): the executor skips a tick's activation
+  hop when no rank runs a fwd op and the gradient hop when no rank
+  runs bwd/bwd_input (``lower()``'s ship_y/ship_g tables,
+  models/schedule.py), so zb's W-rich drain ticks ship 0 hops,
+  warmup/drain ticks 1, steady-state ticks 2 — the per-tick
+  variation that lets least squares SPLIT the constant from the
+  per-hop cost. (The raw IR hop tuple is identical on every tick —
+  the round-20 report's collinear design; ``hop_design_varies``
+  says whether the fit you are reading had the variation.)
 - **Device-trace join.** :func:`join_device_trace` matches
   ``profiling.device_collective_intervals`` hop events to the
   program's shipping ticks with the ledger's cyclic ``i mod len``
@@ -62,6 +72,7 @@ from tpu_p2p.config import TICK_LOWERINGS, TRACE_SCHEDULES
 __all__ = ["TickRecorder", "TickSpan", "rounds_from_stamps",
            "spans_from_round", "measured_per_rank",
            "tick_wall_durations", "kind_decomposition",
+           "effective_hops",
            "tick_kind_map", "join_device_trace", "ordering_agreement",
            "idle_tick_agreement", "run_flight_recorder",
            "render_report", "trace_main"]
@@ -218,6 +229,22 @@ def tick_kind_map(program) -> Dict[Tuple[int, int], str]:
     return out
 
 
+def effective_hops(tick) -> int:
+    """Hops that actually SHIP this tick — the executor's per-tick
+    elision rule replicated on the IR: ``lower()`` skips the
+    activation hop on ticks where no rank runs a ``fwd`` op and the
+    gradient hop where no rank runs ``bwd``/``bwd_input`` (the
+    ship_y/ship_g tables, models/schedule.py — "zb's W-rich drain
+    ticks ship nothing"). The IR itself carries one static hop tuple
+    on every tick, so this — not ``len(tick.hops)`` — is what the
+    measured wall time paid for. A payload this rule does not know
+    is counted as shipped (conservative for future hop kinds)."""
+    kinds = {op.kind for op in tick.compute}
+    ships = {"activation": "fwd" in kinds,
+             "gradient": bool(kinds & {"bwd", "bwd_input"})}
+    return sum(1 for h in tick.hops if ships.get(h.payload, True))
+
+
 def kind_decomposition(durations_s: np.ndarray, program) -> dict:
     """Per-tick-kind cost decomposition of measured tick wall times.
 
@@ -225,13 +252,23 @@ def kind_decomposition(durations_s: np.ndarray, program) -> dict:
     tick under :data:`~tpu_p2p.models.schedule.OP_COST`; ``noop``
     when nothing computes) → mean measured ms. Fit: least squares of
     ``duration ~ c0 + ms_per_cost_unit * analytic_cost +
-    ms_per_hop * hops`` — the intercept ``c0`` is the per-tick
-    CONSTANT overhead (scan step + dispatch + stash bookkeeping)
-    that the ROADMAP's PR 17 residual attributes the zb-vs-fused gap
-    to (zb runs ~M·S more ticks; each pays ``c0``). When the fit
-    cannot produce a positive intercept (degenerate design at tiny
-    tick counts) the minimum observed tick duration — itself a hard
-    lower bound on per-tick overhead — is reported instead, and
+    ms_per_hop * effective_hops`` — the intercept ``c0`` is the
+    per-tick CONSTANT overhead (scan step + dispatch + stash
+    bookkeeping) that the ROADMAP's PR 17 residual attributes the
+    zb-vs-fused gap to (zb runs ~M·S more ticks; each pays ``c0``).
+    The hop column counts post-elision shipping
+    (:func:`effective_hops`) — round 21's fix for the round-20
+    report's collinear design (the raw IR hop tuple is identical on
+    every tick, so the old column was a constant the intercept
+    absorbed; on a zb program effective counts run 0/1/2 and the
+    two coefficients separate). ``hop_design_varies`` reports
+    whether the fitted design had that variation — when False (a
+    schedule whose every tick ships the same count, e.g. pure GPipe
+    forward ramps) ``ms_per_hop`` is NOT identifiable and only the
+    intercept+cost split is meaningful. When the fit cannot produce
+    a positive intercept (degenerate design at tiny tick counts)
+    the minimum observed tick duration — itself a hard lower bound
+    on per-tick overhead — is reported instead, and
     ``intercept_from_fit`` says which one you are reading."""
     from tpu_p2p.models.schedule import OP_COST
 
@@ -247,7 +284,7 @@ def kind_decomposition(durations_s: np.ndarray, program) -> dict:
         kinds.append(max(ks, key=lambda k: OP_COST[k]) if ks
                      else "noop")
         cost.append(max((OP_COST[k] for k in ks), default=0.0))
-        hops.append(len(tick.hops))
+        hops.append(effective_hops(tick))
     by_kind: Dict[str, List[float]] = {}
     for i, k in zip(ticks, kinds):
         by_kind.setdefault(k, []).append(float(durations_s[i]) * 1e3)
@@ -259,6 +296,7 @@ def kind_decomposition(durations_s: np.ndarray, program) -> dict:
         "ms_per_cost_unit": None,
         "ms_per_hop": None,
         "intercept_from_fit": False,
+        "hop_design_varies": len(set(hops)) > 1,
         "ticks_fit": len(ticks),
     }
     if not ticks:
@@ -615,7 +653,11 @@ def render_report(report: dict, stream=None) -> None:
             f"# constant overhead: {d['constant_overhead_ms']:.3f} "
             f"ms/tick ({src}); marginal "
             f"{d['ms_per_cost_unit']:.3f} ms per cost unit, "
-            f"{d['ms_per_hop']:.3f} ms per hop — the zb-vs-fused "
+            f"{d['ms_per_hop']:.3f} ms per effective hop"
+            + ("" if d.get("hop_design_varies")
+               else " (COLLINEAR: every tick ships the same count;"
+                    " per-hop not identifiable)")
+            + " — the zb-vs-fused "
             "residual is ticks x this constant (ROADMAP PR 17)\n")
     dj = report["device_join"]
     if dj["device_track"]:

@@ -10,7 +10,11 @@ timestamps), the trainer's ``--obs-jsonl`` step timeline
 (data/step/eval/checkpoint spans), and serve request lifecycles from
 ``{"obs": "request"}`` records (enqueue → prefill → migrate →
 first-token → decode, one track per engine slot lane, disagg
-migration waits visible).
+migration waits visible). KV-reuse events — ``{"obs":
+"serve_reuse"}`` records from the round-21 prefix cache and
+speculative decoder (docs/kv_reuse.md) — ride the same lanes as
+instants: a ``prefix_hit`` at admission, a ``spec_accept`` or
+``spec_reject`` per mixed verify step.
 
 Track layout (docs/tracing.md has the full reading guide):
 
@@ -280,8 +284,12 @@ def _serve_events(records: Sequence[dict]) -> List[dict]:
     queue → prefill → (disagg migrate wait) → decode, with
     first-token and shed instants. A span is emitted only when both
     its endpoints exist in the record (shed requests stop where their
-    lifecycle stopped)."""
+    lifecycle stopped). ``serve_reuse`` records (prefix hits,
+    per-step speculative accept/reject verdicts) render as instants
+    on the lane their request occupies — reuse activity reads in
+    place on the lifecycle it changed, not on a side track."""
     reqs = [r for r in records if r.get("obs") == "request"]
+    reuse = [r for r in records if r.get("obs") == "serve_reuse"]
     evs: List[dict] = []
     if not reqs:
         return evs
@@ -325,6 +333,20 @@ def _serve_events(records: Sequence[dict]) -> List[dict]:
             evs.append(_instant(PID_SERVE, lane,
                                 f"{r.get('outcome', 'shed')} r{rid}",
                                 ts(r["shed_step"]), "shed", args))
+    # Reuse instants anchor to the owning request's lane; a reuse
+    # record whose request never produced a lifecycle row (not in
+    # this stream slice) has no lane and is skipped, not misplaced.
+    for r in reuse:
+        rid = int(r.get("rid") or 0)
+        lane = lane_of.get(rid)
+        if lane is None:
+            continue
+        kind = str(r.get("kind") or "reuse")
+        args = {k: r[k] for k in ("rid", "pages", "tokens",
+                                  "drafted", "accepted")
+                if r.get(k) is not None}
+        evs.append(_instant(PID_SERVE, lane, f"{kind} r{rid}",
+                            ts(r.get("step") or 0), kind, args))
     return evs
 
 

@@ -37,6 +37,19 @@ preempt/shed/stop verdict can be computed without touching a device,
 replayed later inside one scanned program for the bench's
 device-trace throughput slope, and compared across batching modes
 step-for-step (docs/serving.md).
+
+Round 21 (docs/kv_reuse.md) adds the two decode-side multipliers the
+paged layout was built for, both graded bitwise against this module's
+own baseline: **prefix caching** (``prefix_cache=True``) maps
+content-matched full prompt pages copy-on-write out of a refcounted
+:class:`~tpu_p2p.serve.paged_cache.PrefixIndex` instead of
+re-prefilling them — still length-and-PROMPT-driven, so the dry
+schedule stays exact (prompt values exist before any device runs) —
+and **speculative decoding** (``spec_k > 0``), which verifies ngram
+draft proposals through one multi-token mixed step and is therefore
+VALUE-driven: acceptance depends on computed logits, a dry batcher
+cannot represent it, and ``dry=True`` with ``spec_k > 0`` refuses
+loudly rather than return a schedule the device would not follow.
 """
 
 from __future__ import annotations
@@ -49,11 +62,14 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from tpu_p2p.models.decode import ngram_propose, spec_verify
 from tpu_p2p.serve.paged_cache import (
     OutOfPages,
     PagePool,
+    PrefixIndex,
     TRASH_PAGE,
     init_paged_pool,
+    make_page_copy,
     make_paged_lm_step,
     pool_shards,
 )
@@ -116,6 +132,16 @@ class Request:
     decode_shard: Optional[int] = None
     migrated_blocks: int = 0
     migrations: int = 0
+    # KV-reuse lifecycle (round 21, docs/kv_reuse.md): how many
+    # shared pages / prompt tokens this request's admission mapped
+    # out of the prefix index instead of re-prefilling, and the
+    # draft-verify tallies its decode steps accumulated. All stay 0
+    # on the baseline engine.
+    prefix_pages: int = 0
+    prefix_tokens: int = 0
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    decode_steps: int = 0
 
     @property
     def n_prompt(self) -> int:
@@ -159,7 +185,8 @@ class _Slot:
         self.prefill_len = prefill_len
 
 
-def build_slot_inputs(slots, chunk: int, next_tokens):
+def build_slot_inputs(slots, chunk: int, next_tokens,
+                      draft_tokens=None):
     """The mixed step's host-side input triple off a slot bank:
     ``(tokens [B, chunk], pos [B], n_active [B])`` — one row per slot,
     prefill rows carrying their next prompt slice, decode rows their
@@ -168,7 +195,11 @@ def build_slot_inputs(slots, chunk: int, next_tokens):
     batcher's two slot banks (prefill-side and decode-side —
     tpu_p2p/serve/disagg.py) build their step inputs through the ONE
     definition the colocated engine uses; ``next_tokens(slot)`` is
-    the caller's phase policy."""
+    the caller's phase policy. A decode slot whose ``next_tokens``
+    exceeds 1 is a speculative verify window: ``draft_tokens(slot,
+    k)`` supplies the ``k`` proposals that ride behind the committed
+    token (round 21 — the caller reads them back out of the tokens
+    row at acceptance time, so the fed window IS the record)."""
     c = chunk
     n_slots = len(slots)
     tokens = np.zeros((n_slots, c), np.int32)
@@ -184,6 +215,8 @@ def build_slot_inputs(slots, chunk: int, next_tokens):
             tokens[i, :n] = src[s.pos:s.pos + n]
         else:
             tokens[i, 0] = s.req.generated[-1]
+            if n > 1:
+                tokens[i, 1:n] = draft_tokens(s, n - 1)
         n_active[i] = n
     return tokens, pos, n_active
 
@@ -237,6 +270,7 @@ class Batcher:
                  pool_clamp: Optional[int] = None,
                  step_hook: Optional[Callable[[int], None]] = None,
                  pool_name: str = "kv",
+                 prefix_cache: bool = False, spec_k: int = 0,
                  clock: Callable[[], float] = time.monotonic) -> None:
         if mode not in BATCHING_MODES:
             raise ValueError(
@@ -258,6 +292,19 @@ class Batcher:
             raise ValueError(
                 "queue_depth and deadline_steps must be >= 0 "
                 "(0 disables)"
+            )
+        if not 0 <= spec_k <= 7:
+            raise ValueError(
+                f"spec_k must be in 0..7 (window 1 + spec_k tokens "
+                f"fits the 8-row write band), got {spec_k}"
+            )
+        if spec_k and dry:
+            raise ValueError(
+                "speculative decoding is VALUE-driven — acceptance "
+                "depends on verify-step logits, which a dry batcher "
+                "never computes — so dry=True with spec_k > 0 would "
+                "record a schedule the device engine does not follow; "
+                "refusing (docs/kv_reuse.md)"
             )
         if n_shards is None:
             n_shards = pool_shards(mesh) if mesh is not None else 1
@@ -281,6 +328,22 @@ class Batcher:
                                    name=pool_name)
         if pool_clamp is not None:
             self.pool_alloc.clamp_capacity(pool_clamp)
+        self.spec_k = spec_k
+        self.prefix_index = (PrefixIndex(self.pool_alloc)
+                             if prefix_cache else None)
+        # KV-reuse tallies + the trace exporter's instant stream
+        # (docs/kv_reuse.md; obs/trace.py renders reuse_events on the
+        # serve request lanes).
+        self.prefix_hits = 0
+        self.prefix_pages_shared = 0
+        self.prefix_tokens_saved = 0
+        self.cow_forks = 0
+        self.decode_steps = 0
+        self.decode_tokens = 0
+        self.spec_steps = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.reuse_events: List[Dict] = []
         self.queue: deque = deque()
         self.slots: List[Optional[_Slot]] = [None] * slots
         self.tables = np.zeros((slots, max_blocks), np.int32)
@@ -295,8 +358,10 @@ class Batcher:
                 mesh, cfg, page_len=page_len, max_blocks=max_blocks,
                 chunk=chunk)
             self.pool = init_paged_pool(cfg, num_pages, page_len, mesh)
+            self._copy = (make_page_copy(mesh, cfg)
+                          if prefix_cache else None)
         else:
-            self._step, self.pool = None, None
+            self._step, self.pool, self._copy = None, None, None
 
     # ------------------------------------------------------ scheduling
 
@@ -375,23 +440,82 @@ class Batcher:
             prefill_len = req.n_prompt + len(req.generated)
             blocks0 = max(1, -(-prefill_len // self.page_len))
             shard = self._shard_of(i)
+            L = self.page_len
+            shared: List[int] = []
+            resume = 0
+            if self.prefix_index is not None:
+                matched = self.prefix_index.lookup(req.prompt, shard)
+                # Resume where the cached chain ends, rounded DOWN to
+                # the chunk grid (multi-token chunks must start at
+                # pos ≡ 0 mod chunk) and capped at prefill_len - 1:
+                # the first emitted token comes off the last prefilled
+                # row's logits, so even a fully cached prompt replays
+                # its final chunk rather than skipping prefill whole.
+                resume = min(len(matched) * L,
+                             (prefill_len - 1) // self.chunk
+                             * self.chunk)
+                # Map only the matched pages the resume point still
+                # covers; a page containing resume itself is mapped
+                # too — the COW pass forks it before the first
+                # recomputed write lands (the partial-tail fork).
+                shared = matched[:-(-resume // L)] if resume else []
             try:
-                pages = self.pool_alloc.alloc_n(blocks0, shard)
+                fresh = self._alloc_evict(blocks0 - len(shared), shard)
             except OutOfPages:
                 # Head-of-line request does not fit THIS shard's pool;
                 # another free slot may live on a shard with pages.
                 continue
+            if shared:
+                self.pool_alloc.retain(shared, shard)
+            pages = shared + fresh
             self.queue.popleft()
             req.pool = self.pool_alloc.name
-            self.slots[i] = _Slot(req, pages, prefill_len)
+            slot = _Slot(req, pages, prefill_len)
+            slot.pos = resume
+            self.slots[i] = slot
             row = np.full(self.max_blocks, TRASH_PAGE, np.int32)
             row[:blocks0] = pages
             self.tables[i] = row
+            if resume:
+                self.prefix_hits += 1
+                self.prefix_pages_shared += len(shared)
+                self.prefix_tokens_saved += resume
+                req.prefix_pages += len(shared)
+                req.prefix_tokens += resume
+                self.reuse_events.append({
+                    "kind": "prefix_hit", "rid": req.rid,
+                    "step": self.step_idx, "pages": len(shared),
+                    "tokens": resume,
+                })
+
+    def _alloc_evict(self, n: int, shard: int) -> List[int]:
+        """``alloc_n`` with prefix-index relief: when the free list
+        runs dry, evict index references (most recent first) until
+        the allocation fits or the index is drained — a cached page
+        nobody currently maps is strictly less valuable than
+        admitting or advancing live work, and an evicted page that IS
+        still mapped by some slot just loses its index entry (the
+        slot's reference keeps it alive)."""
+        while True:
+            try:
+                return self.pool_alloc.alloc_n(n, shard)
+            except OutOfPages:
+                if (self.prefix_index is None
+                        or not self.prefix_index.evict_one(shard)):
+                    raise
 
     def _next_tokens(self, s: _Slot) -> int:
         if s.phase == "prefill":
             return min(self.chunk, s.prefill_len - s.pos)
-        return 1
+        if not self.spec_k:
+            return 1
+        # Speculative verify window: the committed token plus up to
+        # spec_k drafts, clipped to the chunk width (the token array),
+        # the 8-row write band the step writes from pos, and the
+        # tokens this request may still emit.
+        remaining = s.req.max_new - len(s.req.generated)
+        return 1 + max(0, min(self.spec_k, self.chunk - 1,
+                              8 - s.pos % 8 - 1, remaining - 1))
 
     def _preempt(self, i: int) -> None:
         """Evict slot ``i``: free its pages (atomically — the churn
@@ -437,7 +561,7 @@ class Batcher:
             shard = self._shard_of(i)
             while self.slots[i] is s and len(s.pages) < need:
                 try:
-                    pid = self.pool_alloc.alloc(shard)
+                    pid = self._alloc_evict(1, shard)[0]
                 except OutOfPages:
                     victim = choose_victim(self.slots, shard,
                                            self._shard_of)
@@ -448,9 +572,90 @@ class Batcher:
                 s.pages.append(pid)
                 self.tables[i, len(s.pages) - 1] = pid
 
+    def _fork_page(self, i: int, s: _Slot, blk: int) -> None:
+        """COW fork of slot ``i``'s block ``blk``: allocate a private
+        page, device-copy the shared page's bytes into it, swap the
+        table entry, release the slot's reference on the original.
+        The fork preserves the shared rows bitwise (the device copy)
+        while rows at/after the write point get rewritten before
+        anything reads them — docs/kv_reuse.md walks the argument."""
+        shard = self._shard_of(i)
+        while self.slots[i] is s:
+            try:
+                new = self._alloc_evict(1, shard)[0]
+            except OutOfPages:
+                victim = choose_victim(self.slots, shard,
+                                       self._shard_of)
+                if victim is None:
+                    raise
+                self._preempt(victim)
+                continue
+            old = s.pages[blk]
+            if self._copy is not None:
+                src = np.full(self.n_shards, TRASH_PAGE, np.int32)
+                dst = np.full(self.n_shards, TRASH_PAGE, np.int32)
+                src[shard], dst[shard] = old, new
+                self.pool = self._copy(
+                    self.pool, *self._place_copy(src, dst))
+            s.pages[blk] = new
+            self.tables[i, blk] = new
+            self.pool_alloc.free([old], shard)
+            self.cow_forks += 1
+            return
+
+    def _place_copy(self, src, dst):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_p2p.models.flagship import _axis
+
+        dp = _axis(self.mesh, "dp")
+        epx = _axis(self.mesh, "ep")
+        rows = tuple(a for a in (dp, epx) if a is not None) or None
+        vec = NamedSharding(self.mesh, P(rows))
+        return (jax.device_put(jnp.asarray(src), vec),
+                jax.device_put(jnp.asarray(dst), vec))
+
+    def _cow_writes(self) -> None:
+        """Fork-before-write pass (round 21): any slot whose next
+        write lands in a page with OTHER holders (refcount > 1 — the
+        prefix index pinning registered content, or sharing readers)
+        gets a private copy first, so no two writers ever share a
+        page and indexed bytes are immutable. One check per slot per
+        step suffices: a step writes one 8-row band, which never
+        crosses a page."""
+        if self.prefix_index is None:
+            return
+        for i in range(self.slots_n):
+            s = self.slots[i]
+            if s is None:
+                continue
+            n = self._next_tokens(s)
+            if n <= 0:
+                continue
+            blk = s.pos // self.page_len
+            if (blk < len(s.pages)
+                    and self.pool_alloc.ref(
+                        s.pages[blk], self._shard_of(i)) > 1):
+                self._fork_page(i, s, blk)
+
+    def _register_prefix(self, i: int, s: _Slot) -> None:
+        """Offer a completed prefill's FULL prompt pages to the index
+        — at the prefill→decode flip, the one moment those pages
+        provably hold exactly the prompt's KV (decode writes land at
+        positions ≥ prefill_len, beyond every full prompt page)."""
+        full = s.req.n_prompt // self.page_len
+        if full:
+            self.prefix_index.register(
+                s.req.prompt, s.pages[:full], self._shard_of(i))
+
+    def _draft(self, s: _Slot, k: int) -> List[int]:
+        return ngram_propose(s.req.full_tokens(), k)
+
     def _build_inputs(self):
         return build_slot_inputs(self.slots, self.chunk,
-                                 self._next_tokens)
+                                 self._next_tokens, self._draft)
 
     def _stop_after(self, req: Request) -> bool:
         """Finished after the token just appended? Length-driven by
@@ -471,6 +676,7 @@ class Batcher:
         freed)."""
         self._admit()
         self._grow_tables()
+        self._cow_writes()
         tokens, pos, n_active = self._build_inputs()
         if not int(n_active.sum()):
             # Nothing resident: a pure idle tick (the engine advances
@@ -484,7 +690,9 @@ class Batcher:
             self.step_hook(self.step_idx)
         now = self.clock()
         for s in self.slots:
-            if s is not None and s.phase == "prefill" and s.pos == 0 \
+            # A prefix-hit slot starts at pos == resume, not 0 — its
+            # service still begins this step (round 21).
+            if s is not None and s.phase == "prefill" \
                     and s.req.t_prefill_start is None:
                 s.req.t_prefill_start = now
                 s.req.prefill_start_step = self.step_idx
@@ -507,20 +715,54 @@ class Batcher:
             if s is None:
                 continue
             req, n = s.req, int(n_active[i])
-            s.pos += n
-            emitted = None
-            if s.phase == "prefill" and s.pos >= s.prefill_len:
-                s.phase = "decode"
-                emitted = n - 1       # last prefilled row's logits
-            elif s.phase == "decode":
-                emitted = 0
-            if emitted is not None:
-                tok = (int(np.argmax(logits[i, emitted]))
-                       if logits is not None else 0)
+            decoding = s.phase == "decode"
+            toks: List[int] = []
+            if s.phase == "prefill":
+                s.pos += n
+                if s.pos >= s.prefill_len:
+                    s.phase = "decode"
+                    # Last prefilled row's logits emit the first token.
+                    toks = [int(np.argmax(logits[i, n - 1]))
+                            if logits is not None else 0]
+                    if self.prefix_index is not None:
+                        self._register_prefix(i, s)
+            else:
+                # Decode: row 0 scores the committed token; rows 1..
+                # n-1 verify the drafts that rode in the token row
+                # (speculative window — build_slot_inputs).
+                drafts = tokens[i, 1:n].tolist()
+                if logits is None:
+                    toks = [0]
+                else:
+                    greedy = np.argmax(logits[i, :n], axis=-1)
+                    toks = spec_verify(greedy, drafts)
+                req.decode_steps += 1
+                self.decode_steps += 1
+                if drafts:
+                    acc = len(toks) - 1
+                    self.spec_steps += 1
+                    self.spec_drafted += len(drafts)
+                    self.spec_accepted += acc
+                    req.spec_drafted += len(drafts)
+                    req.spec_accepted += acc
+                    self.reuse_events.append({
+                        "kind": ("spec_accept" if acc
+                                 else "spec_reject"),
+                        "rid": req.rid, "step": self.step_idx,
+                        "drafted": len(drafts), "accepted": acc,
+                    })
+                # Committed token + accepted drafts are now resident;
+                # rows past the acceptance point hold rejected-draft
+                # KV the next window overwrites before any query can
+                # reach them (docs/kv_reuse.md staleness argument).
+                s.pos += len(toks)
+            for tok in toks:
                 if not req.generated:
                     req.t_first_token = now
                     req.first_token_step = self.step_idx
                 req.generated.append(tok)
+                if decoding:
+                    self.decode_tokens += 1
                 if req.pending_preempt_step is not None:
                     # The preemption episode ends at the first token
                     # emitted after recompute — its step span is the
@@ -537,6 +779,7 @@ class Batcher:
                     self.slots[i] = None
                     self.finished.append(req)
                     done.append(req)
+                    break
         self.step_idx += 1
         return done
 
@@ -563,17 +806,21 @@ def simulate_schedule(trace: List[Request], *, slots: int,
                       n_shards: int = 1, queue_depth: int = 0,
                       deadline_steps: int = 0, stop: str = "length",
                       stop_seed: int = 0, eos_prob: float = 0.0,
-                      pool_clamp: Optional[int] = None) -> Dict:
+                      pool_clamp: Optional[int] = None,
+                      prefix_cache: bool = False) -> Dict:
     """Run the scheduler WITHOUT a device: → the exact per-step input
     sequence the mixed step would see, stacked for replay.
 
     Returns ``{"steps", "idle_steps", "tokens": total processed
     (prompt + generated), "stacked": {tokens/pos/n_active/table:
     np [N, ...]}, "requests", "shed", "preempt_events",
-    "preemptions"}``. Valid because scheduling is length-driven
+    "preemptions", "prefix_hits", "prefix_tokens_saved"}``. Valid
+    because scheduling is length-driven
     (module docstring): the 0-valued placeholder tokens change no
     slot transition, no page movement, no preemption, and no seeded
-    stop decision.
+    stop decision. ``prefix_cache`` stays dry-exact because index
+    keys hash PROMPT tokens, which the dry trace carries verbatim;
+    ``spec_k`` has no dry form (value-driven — the Batcher refuses).
     """
     trace = [r.fresh() for r in trace]
     b = Batcher(None, None, None,
@@ -582,7 +829,7 @@ def simulate_schedule(trace: List[Request], *, slots: int,
                 dry=True, n_shards=n_shards, queue_depth=queue_depth,
                 deadline_steps=deadline_steps, stop=stop,
                 stop_seed=stop_seed, eos_prob=eos_prob,
-                pool_clamp=pool_clamp)
+                pool_clamp=pool_clamp, prefix_cache=prefix_cache)
     finished = b.run(trace)
     sched = b.schedule
     stacked = {
@@ -599,6 +846,8 @@ def simulate_schedule(trace: List[Request], *, slots: int,
         "shed": b.shed,
         "preempt_events": b.preempt_events,
         "preemptions": len(b.preempt_events),
+        "prefix_hits": b.prefix_hits,
+        "prefix_tokens_saved": b.prefix_tokens_saved,
     }
 
 

@@ -53,6 +53,22 @@ token stream is BITWISE the colocated engine's (the shared
 :func:`tpu_p2p.models.decode._attend_ffn` body is the parity anchor —
 same chunk schedule on the prefill side, same single-token decode on
 the decode side, migration moves bytes verbatim).
+
+KV reuse composes across the split (round 21, docs/kv_reuse.md):
+``prefix_cache`` lives PREFILL-side — the content-hash index maps
+shared pages in the prefill pool, copy-on-write forks the partial
+tail before a recomputed chunk writes, and a completed prefill
+registers its full prompt pages BEFORE its resident set enters the
+migration queue, so the post-migration ``pool_p.free`` merely drops
+the request's own reference and index-held pages survive across the
+bank boundary with their refcounts intact (the migrated decode copy
+is always private — decode-side pages never need COW). ``spec_k``
+lives DECODE-side — the decode submesh's mixed step verifies the
+ngram draft window exactly like the colocated batcher's, and drafting
+reads only the request's own token history, which migrated with it.
+Both keep parity bitwise for the colocated proof's reasons: prefix
+pages hold the identical bytes a recompute would write, and
+speculative acceptance is exact greedy-token match.
 """
 
 from __future__ import annotations
@@ -63,6 +79,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from tpu_p2p.models.decode import ngram_propose, spec_verify
 from tpu_p2p.serve.batcher import (
     Request,
     _Slot,
@@ -73,8 +90,10 @@ from tpu_p2p.serve.batcher import (
 from tpu_p2p.serve.paged_cache import (
     OutOfPages,
     PagePool,
+    PrefixIndex,
     TRASH_PAGE,
     init_paged_pool,
+    make_page_copy,
     make_paged_lm_step,
 )
 from tpu_p2p.serve.resilience import (
@@ -407,6 +426,7 @@ class DisaggBatcher:
                  eos_prob: float = 0.0,
                  pool_clamp: Optional[int] = None,
                  step_hook: Optional[Callable[[int], None]] = None,
+                 prefix_cache: bool = False, spec_k: int = 0,
                  transport: str = "xla", migrate_chunks: int = 1,
                  placement: Optional[Callable] = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
@@ -416,6 +436,19 @@ class DisaggBatcher:
             raise ValueError(
                 f"unknown stop rule {stop!r}; expected one of "
                 f"{SERVE_STOPS}"
+            )
+        if not 0 <= spec_k <= 7:
+            raise ValueError(
+                f"spec_k must be in 0..7, got {spec_k} (the decode "
+                "window of 1 + spec_k tokens can never exceed the "
+                "8-row write band)"
+            )
+        if spec_k and dry:
+            raise ValueError(
+                "speculative decoding is VALUE-driven — accepted "
+                "window lengths depend on verified token values, so "
+                "no dry twin can replay the schedule; refusing "
+                "(docs/kv_reuse.md)"
             )
         if stop == "eos" and not 0.0 < eos_prob < 1.0:
             raise ValueError(
@@ -464,6 +497,22 @@ class DisaggBatcher:
                                name="prefill")
         self.pool_d = PagePool(num_pages, page_len, n_decode_shards,
                                name="decode")
+        # KV reuse across the split (round 21): the prefix index maps
+        # PREFILL-pool pages (sharing happens where prompts are
+        # computed); speculation windows run on the DECODE bank.
+        self.spec_k = int(spec_k)
+        self.prefix_index = (PrefixIndex(self.pool_p)
+                             if prefix_cache else None)
+        self.prefix_hits = 0
+        self.prefix_pages_shared = 0
+        self.prefix_tokens_saved = 0
+        self.cow_forks = 0
+        self.decode_steps = 0
+        self.decode_tokens = 0
+        self.spec_steps = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.reuse_events: List[Dict] = []
         if pool_clamp is not None:
             # The page_pool_clamp fault clamps the DECODE pool — the
             # side whose lazy growth the preemption path defends.
@@ -499,10 +548,13 @@ class DisaggBatcher:
                 prefill_mesh, decode_mesh, mig_mesh, cfg,
                 page_len=page_len, transport=transport,
                 chunks=migrate_chunks)
+            self._copy_p = (make_page_copy(prefill_mesh, cfg)
+                            if prefix_cache else None)
         else:
             self._step_p = self._step_d = None
             self.pre_pool = self.dec_pool = None
             self.params_p = self.params_d = None
+            self._copy_p = None
             # A dry migrator twin for byte accounting only.
             self.migrator = None
             self._dry_block_bytes = (
@@ -589,25 +641,134 @@ class DisaggBatcher:
                     f"pages but the prefill pool owns only "
                     f"{self.pool_p.capacity} — it could never prefill"
                 )
+            L = self.page_len
+            shared: List[int] = []
+            resume = 0
+            if self.prefix_index is not None:
+                # Same resume rule as the colocated batcher: cached
+                # chain end, rounded down to the chunk grid, capped so
+                # the final chunk always replays (its logits emit the
+                # first token).
+                matched = self.prefix_index.lookup(req.prompt, 0)
+                resume = min(len(matched) * L,
+                             (prefill_len - 1) // self.chunk
+                             * self.chunk)
+                shared = matched[:-(-resume // L)] if resume else []
             try:
-                pages = self.pool_p.alloc_n(blocks0, 0)
+                fresh = self._alloc_evict_p(blocks0 - len(shared))
             except OutOfPages:
                 # Prefill pool fully occupied (active prefills +
                 # migration-queue holds): admission stalls until the
                 # decode side drains a migration.
                 return
+            if shared:
+                self.pool_p.retain(shared, 0)
+            pages = shared + fresh
             self.queue.popleft()
             req.pool = self.pool_p.name
-            self.slots_p[i] = _Slot(req, pages, prefill_len)
+            slot = _Slot(req, pages, prefill_len)
+            slot.pos = resume
+            self.slots_p[i] = slot
             row = np.full(self.max_blocks, TRASH_PAGE, np.int32)
             row[:blocks0] = pages
             self.tables_p[i] = row
+            if resume:
+                self.prefix_hits += 1
+                self.prefix_pages_shared += len(shared)
+                self.prefix_tokens_saved += resume
+                req.prefix_pages += len(shared)
+                req.prefix_tokens += resume
+                self.reuse_events.append({
+                    "kind": "prefix_hit", "rid": req.rid,
+                    "step": self.step_idx, "pages": len(shared),
+                    "tokens": resume,
+                })
+
+    def _alloc_evict_p(self, n: int) -> List[int]:
+        """Prefill-pool ``alloc_n`` with prefix-index relief — the
+        colocated ``_alloc_evict`` against the (single-shard) prefill
+        pool: a dry free list evicts index references newest-first
+        until the allocation fits or the index drains, then the
+        OutOfPages propagates to the caller's stall/raise policy."""
+        while True:
+            try:
+                return self.pool_p.alloc_n(n, 0)
+            except OutOfPages:
+                if (self.prefix_index is None
+                        or not self.prefix_index.evict_one(0)):
+                    raise
 
     def _next_tokens_p(self, s: _Slot) -> int:
         return min(self.chunk, s.prefill_len - s.pos)
 
     def _next_tokens_d(self, s: _Slot) -> int:
-        return 1
+        if not self.spec_k:
+            return 1
+        # The colocated speculative window, verbatim: committed token
+        # plus up to spec_k drafts, clipped to the chunk width, the
+        # 8-row write band from pos, and the remaining token budget.
+        remaining = s.req.max_new - len(s.req.generated)
+        return 1 + max(0, min(self.spec_k, self.chunk - 1,
+                              8 - s.pos % 8 - 1, remaining - 1))
+
+    def _draft(self, s: _Slot, k: int) -> List[int]:
+        return ngram_propose(s.req.full_tokens(), k)
+
+    def _fork_page_p(self, i: int, s: _Slot, blk: int) -> None:
+        """COW fork on the prefill bank: private page, device copy,
+        table swap, drop the reference on the shared original. Unlike
+        the colocated fork there is no preemption relief — prefill
+        slots never grow, so ``run_disagg_engine`` sizes the prefill
+        pool with fork headroom and exhaustion here is a sizing bug
+        worth the loud OutOfPages."""
+        new = self._alloc_evict_p(1)[0]
+        old = s.pages[blk]
+        if self._copy_p is not None:
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from tpu_p2p.models.flagship import _axis
+
+            vec = NamedSharding(self.prefill_mesh,
+                                P((_axis(self.prefill_mesh, "dp"),)))
+            src = jax.device_put(jnp.asarray([old], jnp.int32), vec)
+            dst = jax.device_put(jnp.asarray([new], jnp.int32), vec)
+            self.pre_pool = self._copy_p(self.pre_pool, src, dst)
+        s.pages[blk] = new
+        self.tables_p[i, blk] = new
+        self.pool_p.free([old], 0)
+        self.cow_forks += 1
+
+    def _cow_writes_p(self) -> None:
+        """Fork-before-write over the prefill bank (round 21): a
+        prefix-hit slot's first recomputed chunk may land in the
+        shared partial-tail page — fork it while other holders (the
+        index, concurrent readers) still reference it. One check per
+        slot per step: a chunk writes one 8-row band, which never
+        crosses a page."""
+        if self.prefix_index is None:
+            return
+        for i in range(self.prefill_slots_n):
+            s = self.slots_p[i]
+            if s is None:
+                continue
+            if self._next_tokens_p(s) <= 0:
+                continue
+            blk = s.pos // self.page_len
+            if (blk < len(s.pages)
+                    and self.pool_p.ref(s.pages[blk], 0) > 1):
+                self._fork_page_p(i, s, blk)
+
+    def _register_prefix_p(self, s: _Slot) -> None:
+        """Offer a completed prefill's FULL prompt pages to the index
+        — called BEFORE the resident set enters the migration queue
+        (or is freed on an immediate finish), so the index's retain
+        outlives the post-migration ``pool_p.free`` and shared bytes
+        survive the bank boundary."""
+        full = s.req.n_prompt // self.page_len
+        if full:
+            self.prefix_index.register(s.req.prompt, s.pages[:full], 0)
 
     def _preempt_decode(self, i: int) -> None:
         """Evict decode slot ``i`` and re-enqueue its request at the
@@ -750,10 +911,12 @@ class DisaggBatcher:
         this step."""
         self._admit()
         self._grow_decode()
+        self._cow_writes_p()
         tok_p, pos_p, act_p = build_slot_inputs(
             self.slots_p, self.chunk, self._next_tokens_p)
         tok_d, pos_d, act_d = build_slot_inputs(
-            self.slots_d, self.chunk, self._next_tokens_d)
+            self.slots_d, self.chunk, self._next_tokens_d,
+            self._draft)
         busy_p, busy_d = int(act_p.sum()), int(act_d.sum())
         if not busy_p and not busy_d and not self.mq:
             self.idle_steps += 1
@@ -763,7 +926,9 @@ class DisaggBatcher:
             self.step_hook(self.step_idx)
         now = self.clock()
         for s in self.slots_p:
-            if s is not None and s.pos == 0 \
+            # A prefix-hit slot starts at pos == resume, not 0 — its
+            # service still begins this step (round 21).
+            if s is not None \
                     and s.req.t_prefill_start is None:
                 s.req.t_prefill_start = now
                 s.req.prefill_start_step = self.step_idx
@@ -808,6 +973,12 @@ class DisaggBatcher:
             req.prefill_done_step = self.step_idx
             self.slots_p[i] = None
             self.tables_p[i] = TRASH_PAGE
+            if self.prefix_index is not None:
+                # Register BEFORE the pages leave this bank: the
+                # index's retain is what keeps shared prompt pages
+                # alive through the post-migration (or post-finish)
+                # free.
+                self._register_prefix_p(s)
             if self._stop_after(req):
                 # Finished at first token: nothing to migrate.
                 self.pool_p.free(s.pages, 0)
@@ -817,25 +988,52 @@ class DisaggBatcher:
                 self.mq.append({"req": req, "pages": s.pages,
                                 "prefill_len": s.prefill_len,
                                 "done_step": self.step_idx})
-        # Decode bank: one generated token per busy slot.
+        # Decode bank: the committed token plus any accepted drafts
+        # per busy slot (spec_k=0 degenerates to exactly one token —
+        # the pre-round-21 path).
         for i, s in enumerate(self.slots_d):
             if s is None or not int(act_d[i]):
                 continue
-            req = s.req
-            s.pos += 1
-            tok = (int(np.argmax(logits_d[i, 0]))
-                   if logits_d is not None else 0)
-            req.generated.append(tok)
-            if req.pending_preempt_step is not None:
-                req.preempt_recover_steps.append(
-                    self.step_idx - req.pending_preempt_step)
-                req.pending_preempt_step = None
-            if self._stop_after(req):
-                self.pool_d.free(s.pages, self._shard_of_d(i))
-                self.tables_d[i] = TRASH_PAGE
-                self.slots_d[i] = None
-                self._finish(req, now)
-                done.append(req)
+            req, n = s.req, int(act_d[i])
+            drafts = tok_d[i, 1:n].tolist()
+            if logits_d is None:
+                toks: List[int] = [0]
+            else:
+                greedy = np.argmax(logits_d[i, :n], axis=-1)
+                toks = spec_verify(greedy, drafts)
+            req.decode_steps += 1
+            self.decode_steps += 1
+            if drafts:
+                acc = len(toks) - 1
+                self.spec_steps += 1
+                self.spec_drafted += len(drafts)
+                self.spec_accepted += acc
+                req.spec_drafted += len(drafts)
+                req.spec_accepted += acc
+                self.reuse_events.append({
+                    "kind": ("spec_accept" if acc else "spec_reject"),
+                    "rid": req.rid, "step": self.step_idx,
+                    "drafted": len(drafts), "accepted": acc,
+                })
+            # Rows past the acceptance point hold rejected-draft KV
+            # the next window overwrites before any query reaches
+            # them — the colocated staleness argument verbatim
+            # (docs/kv_reuse.md).
+            s.pos += len(toks)
+            for tok in toks:
+                req.generated.append(tok)
+                self.decode_tokens += 1
+                if req.pending_preempt_step is not None:
+                    req.preempt_recover_steps.append(
+                        self.step_idx - req.pending_preempt_step)
+                    req.pending_preempt_step = None
+                if self._stop_after(req):
+                    self.pool_d.free(s.pages, self._shard_of_d(i))
+                    self.tables_d[i] = TRASH_PAGE
+                    self.slots_d[i] = None
+                    self._finish(req, now)
+                    done.append(req)
+                    break
         migrations = self._drain_migrations(now)
         self.events.append({
             "step": self.step_idx,
@@ -871,6 +1069,7 @@ def simulate_disagg_schedule(trace: List[Request], *, slots: int,
                              eos_prob: float = 0.0,
                              pool_clamp: Optional[int] = None,
                              placement: Optional[Callable] = None,
+                             prefix_cache: bool = False,
                              cfg=None) -> Dict:
     """Run the disagg scheduler WITHOUT a device: → the exact
     two-sided event trace the engine would execute — per-step inputs
@@ -882,7 +1081,11 @@ def simulate_disagg_schedule(trace: List[Request], *, slots: int,
     or seeded stop decision. ``placement`` injects a migration
     placement policy (``None`` = free-pages-first); policies read
     only dry-visible candidates, so dry == real holds under any
-    (docs/topology.md).
+    (docs/topology.md). ``prefix_cache`` stays dry-exact too — the
+    index hashes PROMPT values, which the dry twin has. There is
+    deliberately no ``spec_k`` knob here: speculative acceptance
+    depends on verified token VALUES, and the batcher refuses
+    ``spec_k`` under ``dry`` (docs/kv_reuse.md).
     """
     trace = [r.fresh() for r in trace]
     b = DisaggBatcher(
@@ -892,10 +1095,13 @@ def simulate_disagg_schedule(trace: List[Request], *, slots: int,
         max_blocks=max_blocks, chunk=chunk, dry=True,
         n_decode_shards=n_decode_shards, queue_depth=queue_depth,
         deadline_steps=deadline_steps, stop=stop, stop_seed=stop_seed,
-        eos_prob=eos_prob, pool_clamp=pool_clamp, placement=placement)
+        eos_prob=eos_prob, pool_clamp=pool_clamp, placement=placement,
+        prefix_cache=prefix_cache)
     finished = b.run(trace)
     return {
         "steps": b.step_idx,
+        "prefix_hits": b.prefix_hits,
+        "prefix_tokens_saved": b.prefix_tokens_saved,
         "busy_steps": len(b.events),
         "idle_steps": b.idle_steps,
         "events": b.events,
@@ -941,6 +1147,7 @@ def run_disagg_engine(prefill_mesh, decode_mesh, mig_mesh, cfg,
         queue_depth=sc.queue_depth, deadline_steps=sc.deadline_steps,
         stop=sc.stop, stop_seed=sc.seed, eos_prob=sc.eos_prob,
         pool_clamp=pool_clamp, step_hook=step_hook,
+        prefix_cache=sc.prefix_cache, spec_k=sc.spec_k,
         transport=sc.transport, migrate_chunks=sc.migrate_chunks,
         placement=placement, clock=clock)
     t0 = clock()
@@ -994,11 +1201,42 @@ def run_disagg_engine(prefill_mesh, decode_mesh, mig_mesh, cfg,
         "migrate_wait_steps_p50": percentile(waits, 0.50),
         "migrate_wait_steps_max": (max(waits) if waits else None),
     }
+    if sc.prefix_cache or sc.spec_k:
+        # The colocated engine's KV-reuse receipts (round 21,
+        # docs/kv_reuse.md), same keys so graders compare across the
+        # split; added only when a reuse knob is on, keeping baseline
+        # disagg summaries (and goldens) byte-identical.
+        from tpu_p2p.serve.paged_cache import kv_page_bytes
+
+        tok_bytes = kv_page_bytes(cfg, sc.page_len) // sc.page_len
+        ttft_steps = [r.first_token_step - r.enqueue_step
+                      for r in finished
+                      if r.first_token_step is not None]
+        summary.update({
+            "prefix_hits": batcher.prefix_hits,
+            "prefix_pages_shared": batcher.prefix_pages_shared,
+            "prefix_tokens_saved": batcher.prefix_tokens_saved,
+            "prefix_saved_bytes":
+                batcher.prefix_tokens_saved * tok_bytes,
+            "cow_forks": batcher.cow_forks,
+            "spec_decode_steps": batcher.decode_steps,
+            "spec_decode_tokens": batcher.decode_tokens,
+            "serve_spec_accept_rate": _r3(
+                batcher.decode_tokens / batcher.decode_steps
+                if batcher.decode_steps else None),
+            "spec_draft_accept_frac": _r3(
+                batcher.spec_accepted / batcher.spec_drafted
+                if batcher.spec_drafted else None),
+            "serve_ttft_steps_mean": _r3(
+                float(np.mean(ttft_steps)) if ttft_steps else None),
+        })
     if emit is not None:
         for r in finished:
             emit(_request_record(r))
         for r in shed:
             emit(_request_record(r))
+        for ev in batcher.reuse_events:
+            emit({"obs": "serve_reuse", **ev})
         emit({"obs": "serve_summary", **summary})
         if ledger is not None:
             from tpu_p2p.obs.ledger import totals_record

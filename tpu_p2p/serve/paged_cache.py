@@ -42,10 +42,12 @@ beyond-``pos`` mask rests on.
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+import hashlib
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_p2p.models.decode import (
@@ -80,6 +82,19 @@ class PagePool:
     page is never handed out, freeing a page not currently allocated
     (or double-freeing) raises, and after every request of a trace
     finishes the pool is exactly full again (no leak).
+
+    Pages are REFCOUNTED (round 21, docs/kv_reuse.md): ``alloc``
+    hands a page out with refcount 1, :meth:`retain` adds holders
+    (the prefix index, a prefix-hit request mapping a shared page
+    into its table), and :meth:`free` DECREMENTS — a page only
+    returns to the free list when its last holder releases it. A
+    holder must treat any page whose refcount exceeds 1 as
+    read-only; the batcher's copy-on-write pass forks (fresh page +
+    device copy) before the first write into a shared page, which is
+    what keeps "no two writers ever share a page" an invariant
+    rather than a convention (tests/test_serve_reuse.py fuzzes it).
+    Every pre-existing caller allocates, never retains, so refcounts
+    stay 1 and the round-13 alloc/free semantics are untouched.
 
     ``name`` tags the pool's IDENTITY (round-18 satellite,
     docs/serving_disagg.md): the disaggregated engine runs a
@@ -117,6 +132,8 @@ class PagePool:
             for _ in range(n_shards)
         ]
         self._allocated = [set() for _ in range(n_shards)]
+        self._refs: List[Dict[int, int]] = [
+            {} for _ in range(n_shards)]
         self._usable = per_shard - 1
 
     @property
@@ -163,6 +180,7 @@ class PagePool:
             )
         pid = self._free[shard].pop()
         self._allocated[shard].add(pid)
+        self._refs[shard][pid] = 1
         return pid
 
     def alloc_n(self, n: int, shard: int = 0) -> List[int]:
@@ -174,17 +192,50 @@ class PagePool:
             )
         return [self.alloc(shard) for _ in range(n)]
 
-    def free(self, pages: Sequence[int], shard: int = 0) -> None:
-        """Return ``pages`` to the shard's free list — atomically.
+    def ref(self, pid: int, shard: int = 0) -> int:
+        """Current refcount of an allocated page (0 for free pages —
+        the copy-on-write pass asks "may I write this page in
+        place?", which is exactly ``ref == 1``)."""
+        return self._refs[shard].get(pid, 0)
 
-        The whole sequence is validated BEFORE any page moves: a bad
+    def allocated(self, shard: int = 0) -> frozenset:
+        """Snapshot of the shard's live page ids (fuzz-test hook)."""
+        return frozenset(self._allocated[shard])
+
+    def retain(self, pages: Sequence[int], shard: int = 0) -> None:
+        """Add one reference to each of ``pages`` — atomically (the
+        whole list is validated before any count moves, like
+        :meth:`free`). Retaining is how a page gains a second holder:
+        the prefix index pinning registered content, or a prefix-hit
+        request mapping a shared page into its table. A repeated pid
+        in one call is legal (it genuinely takes two references)."""
+        pages = list(pages)
+        for pid in pages:
+            if pid not in self._allocated[shard]:
+                raise ValueError(
+                    f"pool {self.name!r} shard {shard}: page {pid} "
+                    "is not allocated — cannot retain a free or "
+                    "trash page; nothing was retained"
+                )
+        for pid in pages:
+            self._refs[shard][pid] += 1
+
+    def free(self, pages: Sequence[int], shard: int = 0) -> None:
+        """Release one reference to each of ``pages`` — atomically; a
+        page whose count hits 0 returns to the shard's free list.
+
+        The whole sequence is validated BEFORE any count moves: a bad
         entry (double free, trash page, out of range, or the same
         page twice in one call) leaves the pool byte-identical, so a
         caller that catches the error still holds a consistent view
         — the preempt/free/realloc churn invariant
         (tests/test_serve.py). Round 13's loop freed page-by-page:
         ``free([good, bad])`` freed ``good``, then raised, and a
-        retry of the same list double-freed it.
+        retry of the same list double-freed it. A repeated pid in one
+        call stays an error even under refcounts — no single holder
+        legitimately releases the same page twice in one breath, and
+        the strict rule is what catches a table row aliased into two
+        slots (the bug class the COW fork exists to prevent).
         """
         pages = list(pages)
         seen: set = set()
@@ -198,8 +249,139 @@ class PagePool:
                 )
             seen.add(pid)
         for pid in pages:
-            self._allocated[shard].remove(pid)
-            self._free[shard].append(pid)
+            self._refs[shard][pid] -= 1
+            if self._refs[shard][pid] == 0:
+                del self._refs[shard][pid]
+                self._allocated[shard].remove(pid)
+                self._free[shard].append(pid)
+
+
+def kv_page_bytes(cfg: FlagshipConfig, page_len: int) -> int:
+    """Bytes one KV page holds across both projections and all stages
+    — ``2 · stages · H_kv · page_len · Dh · itemsize``. The SAME
+    arithmetic :meth:`tpu_p2p.serve.disagg.KvMigrator.block_bytes`
+    prices a migrated block with, reused here to price prefill bytes
+    a prefix hit AVOIDED writing (the engine's
+    ``prefix_saved_bytes`` summary key and the ledger-style receipt
+    in ``make reuse``)."""
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    return (2 * cfg.stages * cfg.num_kv_heads * page_len
+            * cfg.head_dim * itemsize)
+
+
+def _chain_key(prev: Optional[bytes], page_tokens: np.ndarray) -> bytes:
+    """Position-dependent content hash of one FULL page of prompt
+    tokens: ``H(parent_key ‖ tokens)``. Chaining makes a key commit
+    to the ENTIRE prefix up to and including its page — two prompts
+    share a key iff every token before the page boundary agrees, so
+    an index hit can map the page without re-checking earlier pages
+    token-by-token (the vLLM prefix-sharing keying, PAPERS.md
+    arXiv:2309.06180)."""
+    h = hashlib.blake2b(prev or b"tpu-p2p/prefix", digest_size=16)
+    h.update(np.ascontiguousarray(page_tokens, np.int32).tobytes())
+    return h.digest()
+
+
+class PrefixIndex:
+    """Per-shard map ``chain-key → page id`` over registered FULL
+    pages of prompt tokens — the sharing side of the copy-on-write
+    design (docs/kv_reuse.md).
+
+    The index is a page HOLDER: registering a page retains one
+    reference (:meth:`PagePool.retain`), so an indexed page survives
+    its registering request and is never recycled under a later
+    reader; eviction releases that reference, and the page actually
+    frees only when no slot still maps it. Registered content is
+    immutable by the refcount rule — the index's reference alone
+    makes ``ref >= 2`` for any slot that also holds the page, which
+    forces the batcher's COW fork before any write.
+
+    Page ids are shard-local (like everything in :class:`PagePool`),
+    so each shard keeps its own map: a fleet serving one system
+    prompt prefills it once PER SHARD, which is the honest unit —
+    pages cannot be read across shards without a migration.
+    Eviction pops the most recently registered entry first (chain
+    tails before heads), so under pool pressure matches shorten
+    instead of chains orphaning their heads.
+    """
+
+    def __init__(self, pool: PagePool) -> None:
+        self.pool = pool
+        self.page_len = pool.page_len
+        self._index: List[Dict[bytes, int]] = [
+            {} for _ in range(pool.n_shards)]
+
+    def held(self, shard: int = 0) -> int:
+        """How many pages the shard's index currently references."""
+        return len(self._index[shard])
+
+    def _keys(self, prompt: np.ndarray) -> List[bytes]:
+        """Chain keys for every full page of ``prompt`` (a partial
+        tail page is never keyed — its content is not a full page's,
+        so it can never be shared, only recomputed)."""
+        keys: List[bytes] = []
+        prev: Optional[bytes] = None
+        L = self.page_len
+        for b in range(len(prompt) // L):
+            prev = _chain_key(prev, prompt[b * L:(b + 1) * L])
+            keys.append(prev)
+        return keys
+
+    def lookup(self, prompt: np.ndarray, shard: int = 0) -> List[int]:
+        """Longest indexed chain for ``prompt``: page ids for full
+        prompt pages 0..k-1 where every chain key hits. The caller
+        must :meth:`PagePool.retain` any page it maps — lookup
+        itself takes no references."""
+        pages: List[int] = []
+        idx = self._index[shard]
+        for key in self._keys(prompt):
+            pid = idx.get(key)
+            if pid is None:
+                break
+            pages.append(pid)
+        return pages
+
+    def register(self, prompt: np.ndarray, pages: Sequence[int],
+                 shard: int = 0) -> int:
+        """Offer a completed prefill's full prompt pages (block order)
+        to the index; → how many NEW pages were indexed (existing
+        keys keep their original page — first writer wins, so
+        concurrent prefills of the same prompt dedupe instead of
+        thrash). Each new entry retains its page."""
+        added = 0
+        idx = self._index[shard]
+        for b, key in enumerate(self._keys(prompt)):
+            if b >= len(pages):
+                break
+            if key in idx:
+                continue
+            pid = int(pages[b])
+            self.pool.retain([pid], shard)
+            idx[key] = pid
+            added += 1
+        return added
+
+    def evict_one(self, shard: int = 0) -> bool:
+        """Release the most recently registered entry's reference —
+        the batcher's relief valve when the free list runs dry; →
+        False when the index holds nothing (the caller falls through
+        to preemption)."""
+        idx = self._index[shard]
+        if not idx:
+            return False
+        _, pid = idx.popitem()
+        self.pool.free([pid], shard)
+        return True
+
+    def release_all(self) -> None:
+        """Drop every held reference (drain-time accounting: after
+        this plus every request finishing, the pool is exactly full
+        again — the no-leak invariant extends through the index)."""
+        for shard in range(self.pool.n_shards):
+            idx = self._index[shard]
+            while idx:
+                _, pid = idx.popitem()
+                self.pool.free([pid], shard)
 
 
 def paged_pool_spec(mesh: Mesh) -> P:
@@ -407,3 +589,43 @@ def make_paged_lm_step(mesh: Mesh, cfg: FlagshipConfig, *,
         out_specs=(pool_specs, P(row_spec, None, None)),
     )
     return jax.jit(sm, donate_argnums=(1,))
+
+
+def make_page_copy(mesh: Mesh, cfg: FlagshipConfig):
+    """Jitted per-shard device page copy — the COW fork's mechanism:
+
+    ``(pool, src [n_shards], dst [n_shards]) → pool``
+
+    Each dp×ep shard copies its local page ``src → dst`` (shard-local
+    ids, both K and V, all stages); a shard with nothing to fork
+    passes ``TRASH_PAGE → TRASH_PAGE``, which rewrites trash with
+    trash — the idle no-op, same convention as the mixed step's idle
+    writes. The pool argument is donated, so a fork costs one page of
+    HBM traffic and no reallocation. Forked bytes are bitwise the
+    source page's — the shared-prefix KV a reader keeps is the exact
+    KV the writer computed, which is half of the parity argument in
+    docs/kv_reuse.md (the other half: rows past the fork point are
+    rewritten before anything reads them).
+    """
+    _check_decode_mesh(mesh, cfg)
+    c_spec = paged_pool_spec(mesh)
+    dp_ax, ep_ax = _axis(mesh, "dp"), _axis(mesh, "ep")
+    batch_axes = tuple(a for a in (dp_ax, ep_ax) if a is not None)
+    row_spec = batch_axes if batch_axes else None
+
+    def copy(pool, src, dst):
+        out = {}
+        for name in ("k", "v"):
+            buf = pool[name]
+            page = jax.lax.dynamic_slice_in_dim(buf, src[0], 1, axis=1)
+            out[name] = jax.lax.dynamic_update_slice(
+                buf, page, (0, dst[0], 0, 0, 0))
+        return out
+
+    pool_specs = {"k": c_spec, "v": c_spec}
+    sm = jax.shard_map(
+        copy, mesh=mesh,
+        in_specs=(pool_specs, P(row_spec), P(row_spec)),
+        out_specs=pool_specs,
+    )
+    return jax.jit(sm, donate_argnums=(0,))
